@@ -1,0 +1,41 @@
+"""sparkflow-tpu: a TPU-native deep-learning-on-Spark framework.
+
+A brand-new JAX/XLA/pjit/pallas framework with the capabilities of
+``lifeomic/sparkflow``: a Spark ML ``Estimator``/``Transformer`` pair that drops a
+trainable deep-learning stage into a standard ``pyspark.ml.Pipeline``
+(``fit``/``transform``/save/load preserved) — but where the reference's driver-hosted
+Flask parameter server and Hogwild pickle-over-HTTP gradient exchange (reference:
+``sparkflow/HogwildSparkModel.py``) are replaced by pjit-compiled train steps with XLA
+all-reduce over ICI/DCN, and models ship as JSON-serialized declarative graph specs
+executed by JAX instead of TF1 ``MetaGraphDef`` JSON (reference:
+``sparkflow/graph_utils.py:6-15``).
+
+Public surface (mirrors the reference module-for-module):
+
+- :mod:`sparkflow_tpu.graph_utils`   — ``build_graph`` + optimizer config builders
+- :mod:`sparkflow_tpu.nn`            — the model-definition DSL used inside
+  ``build_graph`` model functions (replaces raw TF1 ops)
+- :mod:`sparkflow_tpu.spark_async`   — ``SparkAsyncDL`` / ``SparkAsyncDLModel``
+  (alias: :mod:`sparkflow_tpu.tensorflow_async` for drop-in imports)
+- :mod:`sparkflow_tpu.hogwild`       — ``HogwildTrainer`` (the
+  ``HogwildSparkModel``-shaped direct-training entry point)
+- :mod:`sparkflow_tpu.pipeline_util` — ``PysparkReaderWriter`` /
+  ``PysparkPipelineWrapper`` persistence
+- :mod:`sparkflow_tpu.model_loader`  — pre-trained checkpoint import
+- :mod:`sparkflow_tpu.parallel`      — mesh / sharding / collectives (DP, TP, SP
+  ring attention; the distributed backend replacing the HTTP parameter server)
+- :mod:`sparkflow_tpu.models`        — registry model zoo (MLP, CNN, autoencoder,
+  ResNet, BERT)
+"""
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "graph_utils",
+    "graphdef",
+    "nn",
+    "core",
+    "trainer",
+    "optimizers",
+    "__version__",
+]

@@ -1,0 +1,701 @@
+"""Declarative, JSON-serializable model graphs executed by JAX.
+
+This is the TPU-native replacement for the reference's model wire format: sparkflow
+serializes a TF1 ``MetaGraphDef`` protobuf to JSON (``sparkflow/graph_utils.py:6-15``)
+and rebuilds a ``tf.Session`` from it on every worker
+(``sparkflow/HogwildSparkModel.py:45-54``, ``sparkflow/ml_util.py:54-73``). Here the
+wire format is a small dataflow graph of named ops (a ``GraphDef``); the executor
+(:class:`GraphModel`) turns it into a pure ``init``/``apply`` pair that is jittable,
+differentiable with ``jax.grad``, and shardable with ``pjit`` — no sessions, no
+mutable graph state, static shapes only.
+
+Tensor naming is TF1-compatible so user-facing strings like ``'x:0'`` and
+``'out/Sigmoid:0'`` (see reference ``examples/autoencoder_example.py:13,38``) keep
+working: every node's output tensor is addressable as ``'<name>:0'``, and layers with
+a fused activation also register ``'<layer>/<Activation>:0'``.
+
+Losses follow the ``tf.losses`` collection convention the reference relies on
+(loss fetched from ``tf.GraphKeys.LOSSES[0]``, ``sparkflow/HogwildSparkModel.py:50``):
+loss ops register themselves in ``GraphDef.losses``. Loss ops here compute
+*per-example* loss vectors so the trainer can mask padded rows (XLA needs static
+batch shapes; the last partial batch is padded and masked, not ragged).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_NAME = "sparkflow-tpu-graph"
+FORMAT_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# GraphDef: nodes + name registry
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    """One op in the dataflow graph. Serializes to a plain JSON dict."""
+
+    __slots__ = ("id", "op", "name", "inputs", "attrs")
+
+    def __init__(self, id: int, op: str, name: str, inputs: List[int], attrs: Dict[str, Any]):
+        self.id = id
+        self.op = op
+        self.name = name
+        self.inputs = inputs
+        self.attrs = attrs
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "op": self.op,
+            "name": self.name,
+            "inputs": list(self.inputs),
+            "attrs": self.attrs,
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Node":
+        return Node(d["id"], d["op"], d["name"], list(d["inputs"]), dict(d["attrs"]))
+
+
+class GraphDef:
+    """A serializable model graph: nodes in topological (creation) order."""
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.losses: List[int] = []  # node ids registered as losses
+        self.aliases: Dict[str, int] = {}  # tensor name -> node id
+        self._name_counts: Dict[str, int] = {}
+        self._taken: set = set()
+
+    # -- construction -------------------------------------------------------
+
+    def unique_name(self, base: str) -> str:
+        n = self._name_counts.get(base, 0)
+        while True:
+            cand = base if n == 0 else f"{base}_{n}"
+            n += 1
+            if cand not in self._taken:
+                self._name_counts[base] = n
+                self._taken.add(cand)
+                return cand
+
+    def add_node(self, op: str, name: Optional[str], inputs: Sequence[int],
+                 attrs: Dict[str, Any], alias: bool = True) -> Node:
+        name = self.unique_name(name or op)
+        node = Node(len(self.nodes), op, name, list(inputs), attrs)
+        self.nodes.append(node)
+        if alias:
+            self.aliases[f"{name}:0"] = node.id
+        return node
+
+    def register_loss(self, node_id: int) -> None:
+        self.losses.append(node_id)
+
+    def add_alias(self, tensor_name: str, node_id: int) -> None:
+        self.aliases[tensor_name] = node_id
+
+    # -- lookup -------------------------------------------------------------
+
+    def resolve(self, tensor_name: str) -> int:
+        """Resolve a TF1-style tensor name ('x:0', 'out/Sigmoid:0', or bare 'x')."""
+        for cand in (tensor_name, f"{tensor_name}:0"):
+            if cand in self.aliases:
+                return self.aliases[cand]
+        known = ", ".join(sorted(self.aliases))
+        raise KeyError(f"tensor {tensor_name!r} not found in graph; known tensors: {known}")
+
+    def placeholders(self) -> List[Node]:
+        return [n for n in self.nodes if n.op == "placeholder"]
+
+    # -- serialization ------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "format": FORMAT_NAME,
+            "version": FORMAT_VERSION,
+            "nodes": [n.to_json() for n in self.nodes],
+            "losses": self.losses,
+            "aliases": self.aliases,
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "GraphDef":
+        d = json.loads(s)
+        if d.get("format") != FORMAT_NAME:
+            raise ValueError(f"not a {FORMAT_NAME} document (format={d.get('format')!r})")
+        g = GraphDef()
+        g.nodes = [Node.from_json(nd) for nd in d["nodes"]]
+        g.losses = list(d["losses"])
+        g.aliases = dict(d["aliases"])
+        # mark full names AND base scope names (e.g. 'out' for 'out/BiasAdd')
+        # as taken so extending a deserialized graph can't silently collide
+        for n in g.nodes:
+            g._taken.add(n.name)
+            g._taken.add(n.name.split("/")[0])
+        for a in g.aliases:
+            g._taken.add(a.split(":")[0])
+        return g
+
+
+# ---------------------------------------------------------------------------
+# Op registry: shape inference, parameter shapes, evaluation
+# ---------------------------------------------------------------------------
+
+Shape = Tuple[Optional[int], ...]
+
+_INITIALIZERS: Dict[str, Callable[..., Any]] = {
+    "glorot_uniform": jax.nn.initializers.glorot_uniform,
+    "glorot_normal": jax.nn.initializers.glorot_normal,
+    "he_uniform": jax.nn.initializers.he_uniform,
+    "he_normal": jax.nn.initializers.he_normal,
+    "lecun_normal": jax.nn.initializers.lecun_normal,
+    "lecun_uniform": jax.nn.initializers.lecun_uniform,
+}
+
+
+def _get_initializer(name: str, gain_axes: Tuple[int, ...] = (-2, -1)):
+    if name == "zeros":
+        return jax.nn.initializers.zeros
+    if name == "ones":
+        return jax.nn.initializers.ones
+    if name.startswith("normal"):
+        stddev = 0.05
+        if "(" in name:
+            stddev = float(name[name.index("(") + 1:name.index(")")])
+        return jax.nn.initializers.normal(stddev)
+    if name in _INITIALIZERS:
+        return _INITIALIZERS[name]()
+    raise ValueError(f"unknown initializer {name!r}")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+_ACTIVATIONS: Dict[str, Callable] = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "softplus": jax.nn.softplus,
+    "swish": jax.nn.swish,
+    "identity": lambda x: x,
+}
+
+# Canonical TF1 op-scope names so 'out/Sigmoid:0'-style tensor names match
+# what the reference's users are used to (tf.layers.dense(name='out',
+# activation=tf.nn.sigmoid) -> tensor 'out/Sigmoid:0').
+_TF_ACT_SCOPE = {
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "softmax": "Softmax",
+    "log_softmax": "LogSoftmax", "gelu": "Gelu", "elu": "Elu",
+    "leaky_relu": "LeakyRelu", "softplus": "Softplus", "swish": "Swish",
+}
+
+
+class _EvalCtx:
+    """Per-apply context threaded through op evaluation."""
+
+    __slots__ = ("params", "feeds", "train", "rng", "compute_dtype")
+
+    def __init__(self, params, feeds, train, rng, compute_dtype):
+        self.params = params
+        self.feeds = feeds
+        self.train = train
+        self.rng = rng
+        self.compute_dtype = compute_dtype
+
+    def next_rng(self):
+        if self.rng is None:
+            raise ValueError("this graph uses dropout during training; pass rng to apply()")
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+
+def _cast(x, dtype):
+    if dtype is None:
+        return x
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(dtype)
+    return x
+
+
+# Each op: infer(node, in_shapes) -> out_shape;
+#          params(node, in_shapes) -> {pname: (shape, init_name)} or {};
+#          eval(node, ins, ctx) -> array.
+
+def _infer_placeholder(node, ins):
+    return tuple(node.attrs["shape"])
+
+
+def _infer_dense(node, ins):
+    return tuple(ins[0][:-1]) + (node.attrs["units"],)
+
+
+def _params_dense(node, ins):
+    in_dim = ins[0][-1]
+    if in_dim is None:
+        raise ValueError(f"dense layer {node.name!r}: input feature dim must be static")
+    p = {"kernel": ((in_dim, node.attrs["units"]), node.attrs.get("kernel_init", "glorot_uniform"))}
+    if node.attrs.get("use_bias", True):
+        p["bias"] = ((node.attrs["units"],), node.attrs.get("bias_init", "zeros"))
+    return p
+
+
+def _eval_dense(node, ins, ctx, p):
+    x = _cast(ins[0], ctx.compute_dtype)
+    k = _cast(p["kernel"], ctx.compute_dtype)
+    y = jnp.matmul(x, k, preferred_element_type=jnp.float32)
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+def _conv_out_dim(size, k, stride, padding):
+    if size is None:
+        return None
+    if padding == "SAME":
+        return -(-size // stride)
+    return -(-(size - k + 1) // stride)
+
+
+def _infer_conv2d(node, ins):
+    n, h, w, _ = ins[0]
+    kh, kw = _pair(node.attrs["kernel_size"])
+    sh, sw = _pair(node.attrs.get("strides", 1))
+    pad = node.attrs.get("padding", "VALID").upper()
+    return (n, _conv_out_dim(h, kh, sh, pad), _conv_out_dim(w, kw, sw, pad), node.attrs["filters"])
+
+
+def _params_conv2d(node, ins):
+    cin = ins[0][-1]
+    kh, kw = _pair(node.attrs["kernel_size"])
+    p = {"kernel": ((kh, kw, cin, node.attrs["filters"]),
+                    node.attrs.get("kernel_init", "glorot_uniform"))}
+    if node.attrs.get("use_bias", True):
+        p["bias"] = ((node.attrs["filters"],), node.attrs.get("bias_init", "zeros"))
+    return p
+
+
+def _eval_conv2d(node, ins, ctx, p):
+    x = _cast(ins[0], ctx.compute_dtype)
+    k = _cast(p["kernel"], ctx.compute_dtype)
+    sh, sw = _pair(node.attrs.get("strides", 1))
+    pad = node.attrs.get("padding", "VALID").upper()
+    y = jax.lax.conv_general_dilated(
+        x, k, window_strides=(sh, sw), padding=pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+def _infer_pool(node, ins):
+    n, h, w, c = ins[0]
+    kh, kw = _pair(node.attrs["pool_size"])
+    sh, sw = _pair(node.attrs.get("strides", node.attrs["pool_size"]))
+    pad = node.attrs.get("padding", "VALID").upper()
+    return (n, _conv_out_dim(h, kh, sh, pad), _conv_out_dim(w, kw, sw, pad), c)
+
+
+def _eval_pool(node, ins, ctx, reducer, init_val):
+    kh, kw = _pair(node.attrs["pool_size"])
+    sh, sw = _pair(node.attrs.get("strides", node.attrs["pool_size"]))
+    pad = node.attrs.get("padding", "VALID").upper()
+    x = ins[0]
+    y = jax.lax.reduce_window(x, init_val, reducer, (1, kh, kw, 1), (1, sh, sw, 1), pad)
+    if node.op == "avg_pool2d":
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pad)
+        y = y / counts
+    return y
+
+
+def _infer_flatten(node, ins):
+    n = ins[0][0]
+    rest = ins[0][1:]
+    if any(d is None for d in rest):
+        raise ValueError("flatten: non-batch dims must be static")
+    return (n, int(np.prod(rest)) if rest else 1)
+
+
+def _infer_reshape(node, ins):
+    shape = list(node.attrs["shape"])
+    # -1 in position 0 keeps the batch dim; a single other -1 is inferred.
+    in_shape = ins[0]
+    known = [d for d in in_shape if d is not None]
+    out = []
+    for i, d in enumerate(shape):
+        if d == -1 and i == 0:
+            out.append(in_shape[0])
+        elif d == -1:
+            out.append(None)  # resolved at eval time
+        else:
+            out.append(int(d))
+    # try to resolve inner -1 statically
+    if None not in in_shape:
+        total = int(np.prod(in_shape))
+        fixed = int(np.prod([d for d in out if d is not None])) or 1
+        out = [d if d is not None else total // fixed for d in out]
+    return tuple(out)
+
+
+def _eval_reshape(node, ins, ctx):
+    shape = [int(d) for d in node.attrs["shape"]]
+    x = ins[0]
+    if shape.count(-1) > 1:
+        # a leading -1 means "keep the batch dim"; resolve it so at most one
+        # unknown remains for jnp.reshape
+        shape[0] = x.shape[0]
+    return jnp.reshape(x, tuple(shape))
+
+
+def _infer_elementwise(node, ins):
+    return ins[0]
+
+
+def _infer_argmax(node, ins):
+    ax = node.attrs.get("axis", 1)
+    s = list(ins[0])
+    del s[ax]
+    return tuple(s)
+
+
+def _infer_matmul(node, ins):
+    return tuple(ins[0][:-1]) + (ins[1][-1],)
+
+
+def _infer_concat(node, ins):
+    ax = node.attrs.get("axis", -1)
+    s = list(ins[0])
+    ax = ax if ax >= 0 else len(s) + ax
+    dims = [i[ax] for i in ins]
+    s[ax] = None if any(d is None for d in dims) else sum(dims)
+    return tuple(s)
+
+
+def _infer_loss(node, ins):
+    return (ins[0][0],)  # per-example vector
+
+
+def _params_layer_norm(node, ins):
+    d = ins[0][-1]
+    return {"scale": ((d,), "ones"), "bias": ((d,), "zeros")}
+
+
+def _eval_layer_norm(node, ins, ctx, p):
+    x = ins[0].astype(jnp.float32)
+    eps = node.attrs.get("epsilon", 1e-6)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    y = y * p["scale"] + p["bias"]
+    return _cast(y, ctx.compute_dtype)
+
+
+def _params_embedding(node, ins):
+    return {"embedding": ((node.attrs["vocab_size"], node.attrs["dim"]),
+                          node.attrs.get("init", "normal(0.02)"))}
+
+
+def _eval_dropout(node, ins, ctx):
+    x = ins[0]
+    if len(node.inputs) > 1:
+        rate = ins[1]
+    else:
+        rate = node.attrs.get("rate", 0.5)
+    mode = node.attrs.get("mode", "keep")  # 'keep': rate = keep-prob (tf.nn.dropout TF1)
+    keep = rate if mode == "keep" else 1.0 - rate
+    if not ctx.train:
+        return x
+    keep = jnp.asarray(keep, jnp.float32)
+
+    def apply_drop(x):
+        mask = jax.random.bernoulli(ctx.next_rng(), jnp.maximum(keep, 1e-8), x.shape)
+        return jnp.where(mask, x / jnp.maximum(keep, 1e-8), jnp.zeros_like(x))
+
+    # keep == 1.0 -> identity; jnp.where keeps it jittable for traced keep values
+    dropped = apply_drop(x)
+    return jnp.where(keep >= 1.0, x, dropped)
+
+
+# Per-example losses (reduced over feature axes only; batch axis preserved so
+# the trainer can mask padded rows).
+
+def _eval_softmax_ce(node, ins, ctx):
+    labels, logits = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    logz = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(labels * logz, axis=tuple(range(1, logits.ndim)))
+
+
+def _eval_sigmoid_ce(node, ins, ctx):
+    labels, logits = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(per, axis=tuple(range(1, logits.ndim)))
+
+
+def _eval_mse(node, ins, ctx):
+    a, b = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    per = jnp.square(a - b)
+    return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+
+def _eval_abs_diff(node, ins, ctx):
+    a, b = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    per = jnp.abs(a - b)
+    return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+
+def _eval_huber(node, ins, ctx):
+    a, b = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    delta = node.attrs.get("delta", 1.0)
+    err = a - b
+    abs_err = jnp.abs(err)
+    quad = jnp.minimum(abs_err, delta)
+    lin = abs_err - quad
+    per = 0.5 * quad * quad + delta * lin
+    return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+
+def _eval_log_loss(node, ins, ctx):
+    labels, preds = ins[0].astype(jnp.float32), ins[1].astype(jnp.float32)
+    eps = 1e-7
+    per = -labels * jnp.log(preds + eps) - (1 - labels) * jnp.log(1 - preds + eps)
+    return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+
+_LOSS_EVALS = {
+    "softmax_cross_entropy": _eval_softmax_ce,
+    "sigmoid_cross_entropy": _eval_sigmoid_ce,
+    "mean_squared_error": _eval_mse,
+    "absolute_difference": _eval_abs_diff,
+    "huber_loss": _eval_huber,
+    "log_loss": _eval_log_loss,
+}
+
+
+class _OpDef:
+    __slots__ = ("infer", "params", "eval")
+
+    def __init__(self, infer, eval, params=None):
+        self.infer = infer
+        self.eval = eval
+        self.params = params
+
+
+def _simple_eval(fn):
+    return lambda node, ins, ctx: fn(ins[0])
+
+
+def _eval_placeholder(node, ins, ctx):
+    if node.name in ctx.feeds:
+        return ctx.feeds[node.name]
+    if "default" in node.attrs:
+        return jnp.asarray(node.attrs["default"],
+                           dtype=node.attrs.get("dtype", "float32"))
+    raise KeyError(f"placeholder {node.name!r} was not fed and has no default")
+
+
+OPS: Dict[str, _OpDef] = {
+    "placeholder": _OpDef(_infer_placeholder, _eval_placeholder),
+    "constant": _OpDef(lambda n, i: tuple(np.asarray(n.attrs["value"]).shape),
+                       lambda n, i, c: jnp.asarray(n.attrs["value"],
+                                                   dtype=n.attrs.get("dtype", "float32"))),
+    "dense": _OpDef(_infer_dense, None, _params_dense),
+    "conv2d": _OpDef(_infer_conv2d, None, _params_conv2d),
+    "max_pool2d": _OpDef(_infer_pool,
+                         lambda n, i, c: _eval_pool(n, i, c, jax.lax.max, -jnp.inf)),
+    "avg_pool2d": _OpDef(_infer_pool,
+                         lambda n, i, c: _eval_pool(n, i, c, jax.lax.add, 0.0)),
+    "flatten": _OpDef(_infer_flatten,
+                      lambda n, i, c: jnp.reshape(i[0], (i[0].shape[0], -1))),
+    "reshape": _OpDef(_infer_reshape, _eval_reshape),
+    "dropout": _OpDef(_infer_elementwise, _eval_dropout),
+    "argmax": _OpDef(_infer_argmax,
+                     lambda n, i, c: jnp.argmax(i[0], axis=n.attrs.get("axis", 1)).astype(jnp.float32)),
+    "add": _OpDef(_infer_elementwise, lambda n, i, c: i[0] + i[1]),
+    "subtract": _OpDef(_infer_elementwise, lambda n, i, c: i[0] - i[1]),
+    "multiply": _OpDef(_infer_elementwise, lambda n, i, c: i[0] * i[1]),
+    "matmul": _OpDef(_infer_matmul,
+                     lambda n, i, c: jnp.matmul(_cast(i[0], c.compute_dtype),
+                                                _cast(i[1], c.compute_dtype),
+                                                preferred_element_type=jnp.float32)),
+    "concat": _OpDef(_infer_concat,
+                     lambda n, i, c: jnp.concatenate(list(i), axis=n.attrs.get("axis", -1))),
+    "layer_norm": _OpDef(_infer_elementwise, None, _params_layer_norm),
+    "embedding": _OpDef(lambda n, i: tuple(i[0]) + (n.attrs["dim"],), None, _params_embedding),
+}
+
+OPS["dense"].eval = _eval_dense
+OPS["conv2d"].eval = _eval_conv2d
+OPS["layer_norm"].eval = _eval_layer_norm
+OPS["embedding"].eval = lambda n, i, c, p: jnp.take(p["embedding"], i[0].astype(jnp.int32), axis=0)
+
+for _name, _act in _ACTIVATIONS.items():
+    if _name == "identity":
+        continue
+    OPS[_name] = _OpDef(_infer_elementwise, _simple_eval(_act))
+
+for _name, _fn in _LOSS_EVALS.items():
+    OPS[_name] = _OpDef(_infer_loss, _fn)
+
+PARAM_OPS = {name for name, od in OPS.items() if od.params is not None}
+LOSS_OPS = set(_LOSS_EVALS)
+
+
+# ---------------------------------------------------------------------------
+# GraphModel: executable init/apply derived from a GraphDef
+# ---------------------------------------------------------------------------
+
+
+class GraphModel:
+    """Executable form of a :class:`GraphDef`: pure ``init``/``apply``.
+
+    ``init(rng)`` returns a params pytree ``{layer_name: {param_name: array}}``
+    in node order (this ordering defines the flat-weight-list compatibility with
+    the reference's ``tf.trainable_variables`` list,
+    ``sparkflow/ml_util.py:9-13``).
+
+    ``apply(params, feeds, outputs=[...])`` evaluates only the subgraph needed
+    for the requested tensors — the analog of fetching named tensors from a
+    ``tf.Session`` (``sparkflow/ml_util.py:65-73``) but pure and jittable.
+    """
+
+    def __init__(self, graphdef: GraphDef, compute_dtype: Optional[Any] = None):
+        self.graphdef = graphdef
+        self.compute_dtype = compute_dtype
+        self._shapes: Dict[int, Shape] = {}
+        self._infer_shapes()
+
+    @staticmethod
+    def from_json(s: str, compute_dtype: Optional[Any] = None) -> "GraphModel":
+        return GraphModel(GraphDef.from_json(s), compute_dtype)
+
+    # -- shapes -------------------------------------------------------------
+
+    def _infer_shapes(self):
+        for node in self.graphdef.nodes:
+            od = OPS.get(node.op)
+            if od is None:
+                raise ValueError(f"unknown op {node.op!r} (node {node.name!r})")
+            in_shapes = [self._shapes[i] for i in node.inputs]
+            self._shapes[node.id] = od.infer(node, in_shapes)
+
+    def tensor_shape(self, tensor_name: str) -> Shape:
+        return self._shapes[self.graphdef.resolve(tensor_name)]
+
+    def input_specs(self) -> Dict[str, Tuple[Shape, str]]:
+        return {n.name: (tuple(n.attrs["shape"]), n.attrs.get("dtype", "float32"))
+                for n in self.graphdef.placeholders()}
+
+    # -- params -------------------------------------------------------------
+
+    def param_specs(self) -> Dict[str, Dict[str, Tuple[Shape, str]]]:
+        specs = {}
+        for node in self.graphdef.nodes:
+            od = OPS[node.op]
+            if od.params is not None:
+                in_shapes = [self._shapes[i] for i in node.inputs]
+                specs[node.name] = od.params(node, in_shapes)
+        return specs
+
+    def init(self, rng) -> Dict[str, Dict[str, jax.Array]]:
+        params = {}
+        for lname, pspec in self.param_specs().items():
+            layer = {}
+            for pname, (shape, init_name) in pspec.items():
+                rng, sub = jax.random.split(rng)
+                init_fn = _get_initializer(init_name)
+                layer[pname] = init_fn(sub, shape, jnp.float32)
+            params[lname] = layer
+        return params
+
+    # -- apply --------------------------------------------------------------
+
+    def _needed(self, targets: Sequence[int]) -> List[Node]:
+        need = set()
+        stack = list(targets)
+        while stack:
+            nid = stack.pop()
+            if nid in need:
+                continue
+            need.add(nid)
+            stack.extend(self.graphdef.nodes[nid].inputs)
+        return [n for n in self.graphdef.nodes if n.id in need]
+
+    def apply(self, params, feeds: Dict[str, Any], outputs: Sequence[str],
+              train: bool = False, rng=None) -> Dict[str, jax.Array]:
+        """Evaluate the graph. ``feeds`` keys may use ':0' suffixes; so may outputs."""
+        norm_feeds = {k.split(":")[0]: v for k, v in feeds.items()}
+        target_ids = [self.graphdef.resolve(o) for o in outputs]
+        ctx = _EvalCtx(params, norm_feeds, train, rng, self.compute_dtype)
+        values: Dict[int, Any] = {}
+        for node in self._needed(target_ids):
+            od = OPS[node.op]
+            ins = [values[i] for i in node.inputs]
+            if od.params is not None:
+                values[node.id] = od.eval(node, ins, ctx, params[node.name])
+            else:
+                values[node.id] = od.eval(node, ins, ctx)
+        return {o: values[t] for o, t in zip(outputs, target_ids)}
+
+    def loss_vector(self, params, feeds: Dict[str, Any], train: bool = True,
+                    rng=None) -> jax.Array:
+        """Per-example total loss (sum of registered losses), shape [batch]."""
+        if not self.graphdef.losses:
+            raise ValueError("graph has no registered losses; use a loss op from "
+                             "sparkflow_tpu.nn (softmax_cross_entropy, mean_squared_error, ...)")
+        names = [f"__loss_{i}" for i in range(len(self.graphdef.losses))]
+        for nm, nid in zip(names, self.graphdef.losses):
+            self.graphdef.aliases.setdefault(nm, nid)
+        outs = self.apply(params, feeds, names, train=train, rng=rng)
+        total = outs[names[0]]
+        for nm in names[1:]:
+            total = total + outs[nm]
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Flat weight-list compatibility helpers
+# ---------------------------------------------------------------------------
+
+
+def params_to_list(model: GraphModel, params: Dict[str, Dict[str, Any]]) -> List[np.ndarray]:
+    """Flatten params to a list of arrays in graph-node (creation) order — the
+    analog of the reference's ``tf.trainable_variables`` weight list
+    (``sparkflow/ml_util.py:9-13``). Order comes from the model's param specs,
+    NOT dict iteration order: ``jax.tree`` ops rebuild dicts with sorted keys,
+    so insertion order is not stable across optimizer updates."""
+    out = []
+    for lname, pspec in model.param_specs().items():
+        for pname in pspec:
+            out.append(np.asarray(params[lname][pname]))
+    return out
+
+
+def list_to_params(model: GraphModel, weights: Sequence[np.ndarray]):
+    params = {}
+    i = 0
+    for lname, pspec in model.param_specs().items():
+        layer = {}
+        for pname in pspec:
+            layer[pname] = jnp.asarray(weights[i])
+            i += 1
+        params[lname] = layer
+    if i != len(weights):
+        raise ValueError(f"weight list has {len(weights)} arrays; model needs {i}")
+    return params

@@ -1,0 +1,262 @@
+"""Optimizer registry: the reference's 10 named optimizers on optax.
+
+The reference maps names to ``tf.train.*Optimizer`` classes
+(``sparkflow/tensorflow_async.py:19-42``): adam, rmsprop, momentum, adadelta,
+adagrad, gradient_descent, adagrad_da, ftrl, proximal_adagrad,
+proximal_gradient_descent — with TF1 keyword options parsed from a JSON string
+Param. Here the same names and option keys produce ``optax.GradientTransformation``s;
+the four optimizers optax lacks (ftrl, adagrad_da, proximal_adagrad,
+proximal_gradient_descent) are implemented below as custom transforms following the
+TF1 update rules. All updates run inside the jitted train step, compiled by XLA —
+there is no parameter-server-side optimizer process (reference
+``sparkflow/HogwildSparkModel.py:190-196``).
+
+Behavior parity notes:
+- unknown optimizer names fall back to gradient_descent, as the reference does
+  (``sparkflow/tensorflow_async.py:40-42``);
+- ``use_locking`` is accepted and ignored: synchronous all-reduce replaces the
+  Hogwild parameter server, so there is no shared mutable state to lock;
+- ``momentum`` defaults its momentum to 0.9 when no options are given, matching
+  ``sparkflow/tensorflow_async.py:36-38``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+# ---------------------------------------------------------------------------
+# Custom transforms for the TF1 optimizers optax does not ship
+# ---------------------------------------------------------------------------
+
+
+class FtrlState(NamedTuple):
+    n: optax.Updates  # sum of squared gradients
+    z: optax.Updates  # ftrl dual variable
+
+
+def ftrl(learning_rate: float = 0.001, learning_rate_power: float = -0.5,
+         initial_accumulator_value: float = 0.1,
+         l1_regularization_strength: float = 0.0,
+         l2_regularization_strength: float = 0.0) -> optax.GradientTransformation:
+    """FTRL-Proximal (McMahan et al.), TF1 ``tf.train.FtrlOptimizer`` semantics."""
+    lr = learning_rate
+    p = -learning_rate_power  # TF convention: power is negative; p > 0
+    l1 = l1_regularization_strength
+    l2 = l2_regularization_strength
+
+    def init_fn(params):
+        return FtrlState(
+            n=jax.tree.map(lambda t: jnp.full_like(t, initial_accumulator_value), params),
+            z=jax.tree.map(jnp.zeros_like, params))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("ftrl requires params")
+
+        def per_leaf(g, n, z, w):
+            n_new = n + jnp.square(g)
+            sigma = (jnp.power(n_new, p) - jnp.power(n, p)) / lr
+            z_new = z + g - sigma * w
+            w_new = jnp.where(
+                jnp.abs(z_new) <= l1,
+                jnp.zeros_like(w),
+                -(z_new - jnp.sign(z_new) * l1) / (jnp.power(n_new, p) / lr + 2.0 * l2))
+            return w_new - w, n_new, z_new
+
+        out = jax.tree.map(per_leaf, grads, state.n, state.z, params)
+        updates = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        n = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        z = jax.tree.map(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, FtrlState(n=n, z=z)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class AdagradDAState(NamedTuple):
+    step: chex.Array
+    g_acc: optax.Updates
+    gg_acc: optax.Updates
+
+
+def adagrad_da(learning_rate: float = 0.001,
+               initial_gradient_squared_accumulator_value: float = 0.1,
+               l1_regularization_strength: float = 0.0,
+               l2_regularization_strength: float = 0.0) -> optax.GradientTransformation:
+    """Adagrad Dual Averaging (Xiao 2010), TF1 ``tf.train.AdagradDAOptimizer``."""
+    lr = learning_rate
+    l1 = l1_regularization_strength
+    l2 = l2_regularization_strength
+
+    def init_fn(params):
+        return AdagradDAState(
+            step=jnp.zeros([], jnp.int32),
+            g_acc=jax.tree.map(jnp.zeros_like, params),
+            gg_acc=jax.tree.map(
+                lambda t: jnp.full_like(t, initial_gradient_squared_accumulator_value),
+                params))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("adagrad_da requires params")
+        t = (state.step + 1).astype(jnp.float32)
+
+        def per_leaf(g, ga, gg, w):
+            ga_new = ga + g
+            gg_new = gg + jnp.square(g)
+            clipped = jnp.sign(ga_new) * jnp.maximum(jnp.abs(ga_new) - l1 * t, 0.0)
+            w_new = -lr * clipped / (jnp.sqrt(gg_new) + l2 * t * lr)
+            return w_new - w, ga_new, gg_new
+
+        out = jax.tree.map(per_leaf, grads, state.g_acc, state.gg_acc, params)
+        updates = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        ga = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        gg = jax.tree.map(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, AdagradDAState(step=state.step + 1, g_acc=ga, gg_acc=gg)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ProximalAdagradState(NamedTuple):
+    accum: optax.Updates
+
+
+def _prox(w, step_size, l1, l2):
+    """Proximal operator for l1/l2 regularization (TF1 proximal_* semantics)."""
+    shrunk = jnp.sign(w) * jnp.maximum(jnp.abs(w) - step_size * l1, 0.0)
+    return shrunk / (1.0 + step_size * l2)
+
+
+def proximal_adagrad(learning_rate: float = 0.001,
+                     initial_accumulator_value: float = 0.1,
+                     l1_regularization_strength: float = 0.0,
+                     l2_regularization_strength: float = 0.0) -> optax.GradientTransformation:
+    lr = learning_rate
+    l1 = l1_regularization_strength
+    l2 = l2_regularization_strength
+
+    def init_fn(params):
+        return ProximalAdagradState(
+            accum=jax.tree.map(lambda t: jnp.full_like(t, initial_accumulator_value), params))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("proximal_adagrad requires params")
+
+        def per_leaf(g, a, w):
+            a_new = a + jnp.square(g)
+            step = lr / jnp.sqrt(a_new)
+            w_new = _prox(w - step * g, step, l1, l2)
+            return w_new - w, a_new
+
+        out = jax.tree.map(per_leaf, grads, state.accum, params)
+        updates = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        accum = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, ProximalAdagradState(accum=accum)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def proximal_gradient_descent(learning_rate: float = 0.001,
+                              l1_regularization_strength: float = 0.0,
+                              l2_regularization_strength: float = 0.0) -> optax.GradientTransformation:
+    lr = learning_rate
+    l1 = l1_regularization_strength
+    l2 = l2_regularization_strength
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("proximal_gradient_descent requires params")
+        updates = jax.tree.map(lambda g, w: _prox(w - lr * g, lr, l1, l2) - w, grads, params)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Registry / factory
+# ---------------------------------------------------------------------------
+
+
+def _pop(options: Dict[str, Any], *names, default=None):
+    for n in names:
+        if n in options:
+            return options.pop(n)
+    return default
+
+
+def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
+                    optimizer_options: Optional[Dict[str, Any]] = None
+                    ) -> optax.GradientTransformation:
+    """Name + TF1-style options dict -> optax transformation.
+
+    Mirrors the reference factory (``sparkflow/tensorflow_async.py:17-42``):
+    when ``optimizer_options`` is None, uses ``learning_rate`` with TF-like
+    defaults; unknown names fall back to gradient_descent.
+    """
+    opts = dict(optimizer_options or {})
+    opts.pop("use_locking", None)
+    lr = _pop(opts, "learning_rate", default=learning_rate if learning_rate is not None else 0.001)
+
+    if optimizer_name == "adam":
+        return optax.adam(lr, b1=_pop(opts, "beta1", "b1", default=0.9),
+                          b2=_pop(opts, "beta2", "b2", default=0.999),
+                          eps=_pop(opts, "epsilon", "eps", default=1e-8))
+    if optimizer_name == "rmsprop":
+        return optax.rmsprop(lr, decay=_pop(opts, "decay", default=0.9),
+                             eps=_pop(opts, "epsilon", "eps", default=1e-10),
+                             centered=bool(_pop(opts, "centered", default=False)),
+                             momentum=_pop(opts, "momentum", default=0.0))
+    if optimizer_name == "momentum":
+        return optax.sgd(lr, momentum=_pop(opts, "momentum", default=0.9),
+                         nesterov=bool(_pop(opts, "use_nesterov", default=False)))
+    if optimizer_name == "adadelta":
+        return optax.adadelta(lr, rho=_pop(opts, "rho", default=0.95),
+                              eps=_pop(opts, "epsilon", "eps", default=1e-8))
+    if optimizer_name == "adagrad":
+        return optax.adagrad(lr, initial_accumulator_value=_pop(
+            opts, "initial_accumulator", "initial_accumulator_value", default=0.1))
+    if optimizer_name == "ftrl":
+        return ftrl(lr,
+                    learning_rate_power=_pop(opts, "learning_rate_power", default=-0.5),
+                    initial_accumulator_value=_pop(opts, "initial_accumulator_value", default=0.1),
+                    l1_regularization_strength=_pop(opts, "l1_regularization_strength", default=0.0),
+                    l2_regularization_strength=_pop(opts, "l2_regularization_strength", default=0.0))
+    if optimizer_name == "adagrad_da":
+        return adagrad_da(lr,
+                          initial_gradient_squared_accumulator_value=_pop(
+                              opts, "initial_gradient_squared_accumulator_value", default=0.1),
+                          l1_regularization_strength=_pop(opts, "l1_regularization_strength", default=0.0),
+                          l2_regularization_strength=_pop(opts, "l2_regularization_strength", default=0.0))
+    if optimizer_name == "proximal_adagrad":
+        return proximal_adagrad(lr,
+                                initial_accumulator_value=_pop(opts, "initial_accumulator_value", default=0.1),
+                                l1_regularization_strength=_pop(opts, "l1_regularization_strength", default=0.0),
+                                l2_regularization_strength=_pop(opts, "l2_regularization_strength", default=0.0))
+    if optimizer_name == "proximal_gradient_descent":
+        return proximal_gradient_descent(lr,
+                                         l1_regularization_strength=_pop(opts, "l1_regularization_strength", default=0.0),
+                                         l2_regularization_strength=_pop(opts, "l2_regularization_strength", default=0.0))
+    # gradient_descent + unknown-name fallback (reference behavior)
+    return optax.sgd(lr)
+
+
+AVAILABLE_OPTIMIZERS = (
+    "adam", "rmsprop", "momentum", "adadelta", "adagrad", "gradient_descent",
+    "adagrad_da", "ftrl", "proximal_adagrad", "proximal_gradient_descent",
+)
+
+
+def build_optimizer_from_json(optimizer_name: str, learning_rate: Optional[float],
+                              optimizer_options_json: Optional[str]) -> optax.GradientTransformation:
+    opts = json.loads(optimizer_options_json) if optimizer_options_json else None
+    return build_optimizer(optimizer_name, learning_rate, opts)

@@ -1,0 +1,218 @@
+"""Synchronous TPU trainer — the replacement for the Hogwild parameter server.
+
+The reference's training runtime (``sparkflow/HogwildSparkModel.py``) spawns a
+Flask HTTP parameter server on the driver and has every Spark partition run
+``iters`` epochs over partition-local data, exchanging full pickled weight/gradient
+payloads per mini-batch. Here the same user-facing knobs (``iters``,
+``miniBatchSize``, ``miniStochasticIters``, ``shufflePerIter``,
+``partitionShuffles``, ``verbose``, ``loss_callback``) drive a synchronous
+data-parallel trainer: the union of partition data is staged onto the device mesh
+once, and each epoch is a single XLA-compiled program (shuffle + ``lax.scan`` over
+fixed-shape mini-batches) with gradient all-reduce over ICI.
+
+Semantics mapping (documented intentional drift from async Hogwild — the north
+star mandates synchronous all-reduce):
+
+- ``iters``             -> epochs over the global dataset (reference: epochs over
+                           each partition's local shard, concurrent+async).
+- ``miniBatchSize``     -> the global batch size per synchronous step.
+- ``miniStochasticIters``-> stochastic mini-batch steps per epoch (drawn from a
+                           fresh permutation, i.e. without replacement — matching
+                           ``np.random.choice(..., replace=False)`` in
+                           ``sparkflow/ml_util.py:121-127``).
+- ``partitionShuffles`` -> outer repeats of the whole ``iters`` loop (the
+                           reference reshuffles partitions between rounds,
+                           ``HogwildSparkModel.py:258-266``; here data is
+                           re-permuted on device every epoch anyway).
+- ``acquireLock``       -> accepted, no-op: synchronous updates are already
+                           serialized; there is no shared mutable server state.
+- Convergence semantics therefore differ from lock-free Hogwild by design;
+  the update rule equals the reference's ``acquireLock=True`` path with
+  simultaneous gradient arrival (sum/mean of worker gradients).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .core import make_epoch_fn, make_loss_fn, make_predict_fn, pad_to_batches
+from .graphdef import GraphDef, GraphModel, params_to_list
+from .optimizers import build_optimizer
+
+logger = logging.getLogger("sparkflow_tpu")
+
+
+class TrainResult:
+    """Outcome of a fit: final params + per-epoch mean losses."""
+
+    __slots__ = ("params", "losses", "examples_per_sec", "wall_time_s")
+
+    def __init__(self, params, losses, examples_per_sec, wall_time_s):
+        self.params = params
+        self.losses = losses
+        self.examples_per_sec = examples_per_sec
+        self.wall_time_s = wall_time_s
+
+
+class Trainer:
+    """Single-controller synchronous trainer over an optional device mesh.
+
+    Parameters mirror the reference estimator's training Params
+    (``sparkflow/tensorflow_async.py:104-121``); ``mesh`` is the TPU-native
+    addition — a ``jax.sharding.Mesh`` whose ``'dp'`` axis shards the batch.
+    """
+
+    def __init__(self,
+                 graph: Union[str, GraphDef, GraphModel],
+                 input_name: str,
+                 label_name: Optional[str] = None,
+                 optimizer: Union[str, optax.GradientTransformation] = "adam",
+                 learning_rate: float = 0.01,
+                 optimizer_options: Optional[Dict[str, Any]] = None,
+                 iters: int = 1000,
+                 mini_batch_size: int = 128,
+                 mini_stochastic_iters: int = -1,
+                 shuffle_per_iter: bool = True,
+                 partition_shuffles: int = 1,
+                 verbose: int = 0,
+                 loss_callback: Optional[Callable] = None,
+                 dropout_name: Optional[str] = None,
+                 acquire_lock: bool = False,  # accepted for API parity; no-op
+                 mesh=None,
+                 seed: int = 0,
+                 compute_dtype=None):
+        if isinstance(graph, GraphModel):
+            self.model = graph
+        elif isinstance(graph, GraphDef):
+            self.model = GraphModel(graph, compute_dtype)
+        else:
+            self.model = GraphModel.from_json(graph, compute_dtype)
+        # fail fast on bad tensor names (otherwise they surface later as a
+        # confusing "placeholder not fed" error from the executor)
+        self.model.graphdef.resolve(input_name)
+        if label_name:
+            self.model.graphdef.resolve(label_name)
+        if dropout_name:
+            self.model.graphdef.resolve(dropout_name)
+        self.input_name = input_name
+        self.label_name = label_name
+        if isinstance(optimizer, str):
+            self.optimizer = build_optimizer(optimizer, learning_rate, optimizer_options)
+        else:
+            self.optimizer = optimizer
+        self.iters = iters
+        self.mini_batch_size = mini_batch_size
+        self.mini_stochastic_iters = mini_stochastic_iters
+        self.shuffle_per_iter = shuffle_per_iter
+        self.partition_shuffles = max(1, partition_shuffles)
+        self.verbose = verbose
+        self.loss_callback = loss_callback
+        self.dropout_name = dropout_name
+        self.mesh = mesh
+        self.seed = seed
+        self.params = None
+
+    # -- batching plan ------------------------------------------------------
+
+    def _plan(self, n: int):
+        """Resolve (mode, batch_size, num_batches) from the reference's three
+        batching modes (``sparkflow/HogwildSparkModel.py:62-92``)."""
+        dp = 1
+        if self.mesh is not None:
+            dp = int(np.prod([s for name, s in zip(self.mesh.axis_names, self.mesh.devices.shape)
+                              if name == "dp"])) or 1
+        bs = self.mini_batch_size
+        if bs is None or bs <= 0 or bs >= n:
+            # full-batch mode (reference clamps miniBatchSize > n similarly,
+            # sparkflow/ml_util.py:105-106)
+            batch = -(-n // dp) * dp
+            return "full", batch, 1
+        batch = -(-bs // dp) * dp  # round batch up to a multiple of dp shards
+        sweeps = -(-n // batch)
+        if self.mini_stochastic_iters and self.mini_stochastic_iters > 0:
+            # exactly miniStochasticIters random batches per epoch, even past
+            # one sweep of the data (reference ml_util.py:121-127 semantics)
+            return "stochastic", batch, self.mini_stochastic_iters
+        return "sweep", batch, sweeps
+
+    # -- fit ----------------------------------------------------------------
+
+    def fit(self, features: np.ndarray, labels: Optional[np.ndarray] = None,
+            init_params=None) -> TrainResult:
+        features = np.ascontiguousarray(features, dtype=np.float32)
+        n = features.shape[0]
+        if n == 0:
+            raise ValueError("no training data")
+        if labels is not None:
+            labels = np.ascontiguousarray(labels, dtype=np.float32)
+            if labels.ndim == 1:
+                labels = labels[:, None]
+
+        mode, batch, num_batches = self._plan(n)
+        # the padded dataset always covers exactly ceil(n/batch) windows; in
+        # stochastic mode num_batches may exceed that (resampled permutations)
+        total = -(-n // batch) * batch
+        x_pad, mask = pad_to_batches(features, batch, total // batch)
+        if labels is not None:
+            y_pad, _ = pad_to_batches(labels, batch, total // batch)
+        else:
+            y_pad = np.zeros((total, 1), np.float32)  # dummy; loss ignores it
+
+        rng = jax.random.PRNGKey(self.seed)
+        init_rng, rng = jax.random.split(rng)
+        params = init_params if init_params is not None else self.model.init(init_rng)
+        opt_state = self.optimizer.init(params)
+
+        loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
+        epoch_fn = make_epoch_fn(loss_fn, self.optimizer, batch, num_batches,
+                                 mode, self.shuffle_per_iter, self.mesh)
+
+        # Stage the dataset on device(s) once; every epoch runs fully on-device.
+        device_args = (jnp.asarray(x_pad), jnp.asarray(y_pad), jnp.asarray(mask))
+
+        loss_handles = []  # device scalars; converted lazily to keep async dispatch
+        t0 = time.perf_counter()
+        it = 0
+        for _round in range(self.partition_shuffles):
+            for _epoch in range(self.iters):
+                rng, erng = jax.random.split(rng)
+                params, opt_state, losses = epoch_fn(params, opt_state,
+                                                     *device_args, erng)
+                it += 1
+                loss_handles.append(jnp.mean(losses))
+                if self.verbose or self.loss_callback is not None:
+                    loss_val = float(loss_handles[-1])  # forces a device sync
+                    if self.verbose:
+                        logger.info("iteration %d loss %f", it, loss_val)
+                    if self.loss_callback is not None:
+                        # reference signature: loss_callback(loss, iteration,
+                        # partition_id) — HogwildSparkModel.py:99-100; there is
+                        # one logical partition here.
+                        self.loss_callback(loss_val, it, 0)
+        # block until the last step is done for honest timing
+        params = jax.block_until_ready(params)
+        wall = time.perf_counter() - t0
+        seen = num_batches * batch * it
+        self.params = params
+        epoch_losses = [float(l) for l in loss_handles]
+        return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
+
+    # -- conveniences -------------------------------------------------------
+
+    def weights_list(self) -> List[np.ndarray]:
+        """Final weights as a flat array list (reference
+        ``tensorflow_get_weights``, ``sparkflow/ml_util.py:9-13``)."""
+        if self.params is None:
+            raise RuntimeError("fit() has not been run")
+        return params_to_list(self.model, self.params)
+
+    def predict_fn(self, output_name: str, dropout_value: float = 1.0) -> Callable:
+        return make_predict_fn(self.model, self.input_name, output_name,
+                               self.dropout_name, dropout_value)

@@ -1,0 +1,45 @@
+"""Test harness: JAX on CPU with 8 virtual devices.
+
+Mirrors the reference's test trick of simulating a cluster locally (a real Flask
+parameter server + `local[2]` Spark, reference ``tests/dl_runner.py:26-40``): here
+the *real* collective/sharding paths run on a virtual 8-device CPU mesh, so
+multi-chip code is exercised without TPU hardware.
+
+NOTE: the axon TPU plugin's sitecustomize overrides ``JAX_PLATFORMS`` env; forcing
+the platform must happen via jax.config before any device use.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # already initialized with the right settings (e.g. driver-run)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def dp_mesh():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    return Mesh(devs.reshape(devs.size), ("dp",))
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(12345)

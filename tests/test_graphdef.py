@@ -1,0 +1,162 @@
+"""Unit tests for the graph DSL, spec serialization, and JAX executor."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.graphdef import GraphDef, GraphModel, list_to_params, params_to_list
+
+
+def mlp_graph():
+    x = nn.placeholder([None, 20], name="x")
+    y = nn.placeholder([None, 3], name="y")
+    h = nn.dense(x, 32, activation="relu")
+    out = nn.dense(h, 3, name="out")
+    nn.argmax(out, 1, name="pred")
+    nn.softmax_cross_entropy(y, out)
+
+
+def test_build_graph_returns_json():
+    mg = build_graph(mlp_graph)
+    assert isinstance(mg, str)
+    g = GraphDef.from_json(mg)
+    assert g.to_json() == GraphDef.from_json(g.to_json()).to_json()
+
+
+def test_tensor_name_compat():
+    """TF1-style tensor names resolve: bare, ':0', and scope-qualified."""
+
+    def m():
+        x = nn.placeholder([None, 4], name="x")
+        nn.dense(x, 2, activation="sigmoid", name="out")
+
+    g = GraphDef.from_json(build_graph(m))
+    a = g.resolve("out/Sigmoid:0")
+    b = g.resolve("out:0")
+    c = g.resolve("out")
+    assert a == b == c
+    with pytest.raises(KeyError):
+        g.resolve("missing:0")
+
+
+def test_apply_and_shapes():
+    m = GraphModel.from_json(build_graph(mlp_graph))
+    params = m.init(jax.random.PRNGKey(0))
+    x = np.random.randn(8, 20).astype(np.float32)
+    y = np.eye(3)[np.random.randint(0, 3, 8)].astype(np.float32)
+    outs = m.apply(params, {"x:0": x, "y:0": y}, ["out:0", "pred:0"])
+    assert outs["out:0"].shape == (8, 3)
+    assert outs["pred:0"].shape == (8,)
+    lv = m.loss_vector(params, {"x": x, "y": y})
+    assert lv.shape == (8,)
+    assert np.all(np.isfinite(np.asarray(lv)))
+
+
+def test_grad_flows():
+    m = GraphModel.from_json(build_graph(mlp_graph))
+    params = m.init(jax.random.PRNGKey(0))
+    x = np.random.randn(4, 20).astype(np.float32)
+    y = np.eye(3)[np.random.randint(0, 3, 4)].astype(np.float32)
+    g = jax.grad(lambda p: m.loss_vector(p, {"x": x, "y": y}).mean())(params)
+    norms = [float(jnp.linalg.norm(leaf)) for leaf in jax.tree.leaves(g)]
+    assert any(n > 0 for n in norms)
+
+
+def test_weight_list_order_stable_after_tree_ops():
+    """jax.tree ops rebuild dicts sorted; flat weight order must not change."""
+    m = GraphModel.from_json(build_graph(mlp_graph))
+    params = m.init(jax.random.PRNGKey(0))
+    shuffled = jax.tree.map(lambda a: a + 1.0, params)  # rebuilds dicts sorted
+    wl = params_to_list(m, shuffled)
+    back = list_to_params(m, wl)
+    for lname in shuffled:
+        for pname in shuffled[lname]:
+            np.testing.assert_allclose(np.asarray(shuffled[lname][pname]),
+                                       np.asarray(back[lname][pname]))
+
+
+def test_cnn_shapes():
+    def cnn():
+        x = nn.placeholder([None, 784], name="x")
+        y = nn.placeholder([None, 10], name="y")
+        xr = nn.reshape(x, [-1, 28, 28, 1])
+        c1 = nn.conv2d(xr, 8, 5, activation="relu")
+        p1 = nn.max_pooling2d(c1, 2, 2)
+        c2 = nn.conv2d(p1, 16, 3, activation="relu")
+        p2 = nn.max_pooling2d(c2, 2, 2)
+        out = nn.dense(nn.flatten(p2), 10, name="out")
+        nn.softmax_cross_entropy(y, out)
+
+    m = GraphModel.from_json(build_graph(cnn))
+    assert m.tensor_shape("out:0") == (None, 10)
+    params = m.init(jax.random.PRNGKey(0))
+    x = np.random.rand(2, 784).astype(np.float32)
+    out = m.apply(params, {"x": x}, ["out:0"])["out:0"]
+    assert out.shape == (2, 10)
+
+
+def test_unsupervised_autoencoder_graph():
+    def ae():
+        x = nn.placeholder([None, 12], name="x")
+        h = nn.dense(x, 4, activation="sigmoid", name="bottleneck")
+        o = nn.dense(h, 12, activation="sigmoid")
+        nn.mean_squared_error(o, x)
+
+    m = GraphModel.from_json(build_graph(ae))
+    params = m.init(jax.random.PRNGKey(1))
+    x = np.random.rand(5, 12).astype(np.float32)
+    mid = m.apply(params, {"x": x}, ["bottleneck/Sigmoid:0"])["bottleneck/Sigmoid:0"]
+    assert mid.shape == (5, 4)
+    assert m.loss_vector(params, {"x": x}).shape == (5,)
+
+
+def test_dropout_train_vs_eval():
+    def m():
+        x = nn.placeholder([None, 100], name="x")
+        kp = nn.placeholder_with_default(0.5, name="kp")
+        h = nn.dropout(x, keep_prob=kp)
+        nn.mean_squared_error(h, x)
+
+    gm = GraphModel.from_json(build_graph(m))
+    params = gm.init(jax.random.PRNGKey(0))
+    x = np.ones((4, 100), np.float32)
+    # eval mode: identity
+    out = gm.apply(params, {"x": x}, ["dropout:0"], train=False)["dropout:0"]
+    np.testing.assert_allclose(np.asarray(out), x)
+    # train mode, default keep=0.5: roughly half dropped, survivors scaled 2x
+    out_t = gm.apply(params, {"x": x}, ["dropout:0"], train=True,
+                     rng=jax.random.PRNGKey(3))["dropout:0"]
+    frac_zero = float((np.asarray(out_t) == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    # train mode but keep fed as 1.0 (predict-style feed): identity
+    out_k = gm.apply(params, {"x": x, "kp": 1.0}, ["dropout:0"], train=True,
+                     rng=jax.random.PRNGKey(3))["dropout:0"]
+    np.testing.assert_allclose(np.asarray(out_k), x)
+
+
+def test_reshape_double_unknown():
+    def m():
+        x = nn.placeholder([None, 12], name="x")
+        r = nn.reshape(x, [-1, 3, -1])
+        nn.mean_squared_error(r, r)
+
+    gm = GraphModel.from_json(build_graph(m))
+    params = gm.init(jax.random.PRNGKey(0))
+    out = gm.apply(params, {"x": np.zeros((2, 12), np.float32)}, ["reshape:0"])
+    assert out["reshape:0"].shape == (2, 3, 4)
+
+
+def test_extend_deserialized_graph_no_alias_clobber():
+    def m():
+        x = nn.placeholder([None, 4], name="x")
+        nn.dense(x, 2)  # auto-named 'dense'
+
+    g = GraphDef.from_json(build_graph(m))
+    before = g.resolve("dense:0")
+    with nn.graph_scope(g):
+        nn.dense(nn.Sym(g, 0), 3)  # must become dense_1, not clobber 'dense:0'
+    assert g.resolve("dense:0") == before
+    assert "dense_1:0" in g.aliases

@@ -1,0 +1,89 @@
+"""All 10 named optimizers step and reduce loss on a convex problem."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sparkflow_tpu.graph_utils import (build_adam_config, build_adadelta_config,
+                                       build_adagrad_config, build_ftrl_config,
+                                       build_gradient_descent,
+                                       build_momentum_config,
+                                       build_rmsprop_config, generate_config)
+from sparkflow_tpu.optimizers import (AVAILABLE_OPTIMIZERS, build_optimizer,
+                                      build_optimizer_from_json)
+
+
+def quad_loss(p):
+    return jnp.sum(jnp.square(p["w"]["v"] - 3.0))
+
+
+@pytest.mark.parametrize("name", AVAILABLE_OPTIMIZERS)
+def test_optimizer_reduces_convex_loss(name):
+    params = {"w": {"v": jnp.zeros((4,))}}
+    opt = build_optimizer(name, learning_rate=0.1, optimizer_options=None)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(quad_loss)(params)
+        upd, state = opt.update(g, state, params)
+        return optax.apply_updates(params, upd), state, loss
+
+    loss0 = float(quad_loss(params))
+    for _ in range(60):
+        params, state, loss = step(params, state)
+    assert float(loss) < loss0
+
+
+def test_unknown_name_falls_back_to_sgd():
+    """Reference behavior: unknown names use gradient_descent
+    (sparkflow/tensorflow_async.py:40-42)."""
+    opt = build_optimizer("definitely_not_real", 0.5, None)
+    params = {"w": {"v": jnp.array([1.0])}}
+    upd, _ = opt.update({"w": {"v": jnp.array([1.0])}}, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["w"]["v"]), [-0.5])
+
+
+def test_config_builders_round_trip():
+    for cfg, name in [
+        (build_adam_config(learning_rate=0.002, beta1=0.8), "adam"),
+        (build_rmsprop_config(decay=0.95, centered=True), "rmsprop"),
+        (build_momentum_config(momentum=0.5, use_nesterov=True), "momentum"),
+        (build_adadelta_config(rho=0.9), "adadelta"),
+        (build_adagrad_config(initial_accumulator=0.2), "adagrad"),
+        (build_gradient_descent(learning_rate=0.3), "gradient_descent"),
+        (build_ftrl_config(l1_regularization_strength=0.01), "ftrl"),
+        (generate_config(learning_rate=0.1, use_locking=True), "proximal_adagrad"),
+    ]:
+        opt = build_optimizer_from_json(name, None, cfg)
+        params = {"w": {"v": jnp.ones((2,))}}
+        upd, _ = opt.update({"w": {"v": jnp.ones((2,))}}, opt.init(params), params)
+        assert np.all(np.isfinite(np.asarray(upd["w"]["v"])))
+
+
+def test_ftrl_l1_produces_sparsity():
+    """FTRL with strong l1 should drive small-signal weights to exactly zero."""
+    opt = build_optimizer("ftrl", 0.5, {"l1_regularization_strength": 2.0})
+    params = {"w": {"v": jnp.array([0.0, 0.0])}}
+    state = opt.init(params)
+    g = {"w": {"v": jnp.array([0.01, -0.01])}}  # tiny gradients: l1 dominates
+    for _ in range(5):
+        upd, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+    np.testing.assert_allclose(np.asarray(params["w"]["v"]), [0.0, 0.0])
+
+
+def test_momentum_default_when_no_options():
+    """momentum defaults to 0.9 with no options (tensorflow_async.py:36-38):
+    two identical-gradient steps must move farther than 2x a single step."""
+    opt = build_optimizer("momentum", 1.0, None)
+    params = {"w": {"v": jnp.array([0.0])}}
+    state = opt.init(params)
+    g = {"w": {"v": jnp.array([1.0])}}
+    upd1, state = opt.update(g, state, params)
+    params = optax.apply_updates(params, upd1)
+    upd2, state = opt.update(g, state, params)
+    # second update includes momentum: |upd2| = 1 + 0.9
+    np.testing.assert_allclose(np.asarray(upd2["w"]["v"]), [-1.9], rtol=1e-6)

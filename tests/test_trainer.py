@@ -1,0 +1,128 @@
+"""Trainer: batching modes, mesh DP, callbacks, masking, unsupervised path."""
+
+import jax
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.core import predict_in_chunks
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.trainer import Trainer
+
+
+def clf_graph():
+    x = nn.placeholder([None, 10], name="x")
+    y = nn.placeholder([None, 2], name="y")
+    h = nn.dense(x, 16, activation="relu")
+    out = nn.dense(h, 2, name="out")
+    nn.softmax_cross_entropy(y, out)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rs = np.random.RandomState(0)
+    X = rs.randn(403, 10).astype(np.float32)  # deliberately not batch-aligned
+    lbl = (X @ rs.randn(10) > 0).astype(int)
+    return X, np.eye(2)[lbl].astype(np.float32), lbl
+
+
+def _acc(tr, res, X, lbl):
+    preds = predict_in_chunks(tr.predict_fn("out:0"), res.params, X).argmax(1)
+    return (preds == lbl).mean()
+
+
+def test_sweep_mode_learns(data):
+    X, Y, lbl = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=30, mini_batch_size=64)
+    res = tr.fit(X, Y)
+    assert _acc(tr, res, X, lbl) > 0.9
+    assert len(res.losses) == 30
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_stochastic_mode_more_iters_than_sweeps(data):
+    X, Y, lbl = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=5,
+                 mini_batch_size=64, mini_stochastic_iters=20)
+    res = tr.fit(X, Y)
+    assert _acc(tr, res, X, lbl) > 0.8
+
+
+def test_full_batch_mode(data):
+    X, Y, lbl = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=60, mini_batch_size=-1,
+                 learning_rate=0.05)
+    res = tr.fit(X, Y)
+    assert _acc(tr, res, X, lbl) > 0.8
+
+
+def test_dp_mesh_training(data, dp_mesh):
+    X, Y, lbl = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=30,
+                 mini_batch_size=64, mesh=dp_mesh)
+    res = tr.fit(X, Y)
+    assert _acc(tr, res, X, lbl) > 0.9
+
+
+def test_unsupervised(data):
+    X, _, _ = data
+
+    def ae():
+        x = nn.placeholder([None, 10], name="x")
+        h = nn.dense(x, 4, activation="relu", name="mid")
+        o = nn.dense(h, 10)
+        nn.mean_squared_error(o, x)
+
+    tr = Trainer(build_graph(ae), "x:0", None, iters=40, mini_batch_size=64,
+                 learning_rate=0.005)
+    res = tr.fit(X)
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_loss_callback_signature(data):
+    X, Y, _ = data
+    calls = []
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=3,
+                 loss_callback=lambda loss, it, pid: calls.append((loss, it, pid)))
+    tr.fit(X, Y)
+    assert [c[1] for c in calls] == [1, 2, 3]
+    assert all(c[2] == 0 for c in calls)
+
+
+def test_partition_shuffles_multiplies_epochs(data):
+    X, Y, _ = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=2, partition_shuffles=3)
+    res = tr.fit(X, Y)
+    assert len(res.losses) == 6
+
+
+def test_bad_tensor_name_fails_fast():
+    with pytest.raises(KeyError, match="not found in graph"):
+        Trainer(build_graph(clf_graph), "nope:0", "y:0")
+
+
+def test_padding_mask_correctness():
+    """A dataset of size 1 with batch 64: padded rows must not affect loss."""
+
+    def m():
+        x = nn.placeholder([None, 2], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        out = nn.dense(x, 1, name="out")
+        nn.mean_squared_error(y, out)
+
+    X = np.array([[1.0, 2.0]], np.float32)
+    Y = np.array([[3.0]], np.float32)
+    tr = Trainer(build_graph(m), "x:0", "y:0", iters=200, mini_batch_size=64,
+                 learning_rate=0.1, optimizer="gradient_descent")
+    res = tr.fit(X, Y)
+    pred = predict_in_chunks(tr.predict_fn("out:0"), res.params, X)
+    np.testing.assert_allclose(pred, Y, atol=1e-2)
+
+
+def test_empty_predict_keeps_rank():
+    X = np.zeros((0, 10), np.float32)
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=1)
+    res = tr.fit(np.random.rand(8, 10).astype(np.float32),
+                 np.eye(2)[np.random.randint(0, 2, 8)])
+    out = predict_in_chunks(tr.predict_fn("out:0"), res.params, X)
+    assert out.shape == (0, 2)
