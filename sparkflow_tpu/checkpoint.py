@@ -1,0 +1,119 @@
+"""Step-level checkpoint / resume (orbax-backed).
+
+The reference has save-at-end only: weights become a JSON string Param and
+optimizer state dies with the parameter-server process (SURVEY.md §5
+"Checkpoint/resume"). This module is the capability upgrade: periodic
+checkpoints of (params, opt_state, step, rng) during training, resumable
+mid-run, plus a plain-weights export for the model loader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+from .graphdef import GraphModel, list_to_params, params_to_list
+
+
+class CheckpointManager:
+    """Periodic training checkpoints under one directory.
+
+    Layout: ``<dir>/step_<n>/state`` (orbax pytree) + ``<dir>/latest.json``.
+    Falls back to npz-per-leaf if orbax is unavailable.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def save(self, step: int, state: Dict[str, Any]) -> None:
+        path = self._step_dir(step)
+        state = jax.tree.map(np.asarray, state)
+        if _HAVE_ORBAX:
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+        else:  # pragma: no cover
+            os.makedirs(path, exist_ok=True)
+            flat, treedef = jax.tree.flatten(state)
+            np.savez(os.path.join(path, "state.npz"),
+                     **{f"l_{i}": x for i, x in enumerate(flat)})
+            with open(os.path.join(path, "treedef.json"), "w") as f:
+                json.dump(str(treedef), f)
+        with open(os.path.join(self.directory, "latest.json"), "w") as f:
+            json.dump({"latest_step": step}, f)
+        self._gc()
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            import shutil
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        p = os.path.join(self.directory, "latest.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f).get("latest_step")
+
+    def restore(self, step: Optional[int] = None,
+                like: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, Any]]:
+        """Restore the state pytree at ``step`` (default: latest). ``like`` is
+        a template pytree used to restore exact structure/dtypes."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        path = self._step_dir(step)
+        if _HAVE_ORBAX:
+            ckptr = ocp.PyTreeCheckpointer()
+            if like is not None:
+                template = jax.tree.map(np.asarray, like)
+                return ckptr.restore(os.path.join(path, "state"), item=template)
+            return ckptr.restore(os.path.join(path, "state"))
+        raise RuntimeError("orbax unavailable and npz fallback needs `like`")
+
+    # -- plain-weights interop (model_loader) -------------------------------
+
+    @staticmethod
+    def save_weights(directory: str, model: GraphModel, params) -> None:
+        os.makedirs(directory, exist_ok=True)
+        weights = params_to_list(model, params)
+        np.savez(os.path.join(directory, "weights.npz"),
+                 **{f"w_{i}": w for i, w in enumerate(weights)})
+
+    @staticmethod
+    def load_weights(directory: str, model: GraphModel) -> List[np.ndarray]:
+        p = os.path.join(directory, "weights.npz")
+        if os.path.exists(p):
+            with np.load(p) as z:
+                return [z[k] for k in sorted(z.files, key=lambda s: int(s.split("_")[-1]))]
+        # orbax training checkpoint: pull params out of the latest state
+        mgr = CheckpointManager(directory)
+        state = mgr.restore()
+        if state is None or "params" not in state:
+            raise FileNotFoundError(f"no weights.npz or checkpoints in {directory}")
+        return params_to_list(model, state["params"])

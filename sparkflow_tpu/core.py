@@ -67,6 +67,18 @@ def make_loss_fn(model: GraphModel, input_name: str,
     return loss_fn
 
 
+def _step_body(loss_fn: Callable, optimizer: optax.GradientTransformation) -> Callable:
+    """The one optimizer step shared by make_train_step and make_epoch_fn."""
+
+    def step(params, opt_state, x, y, mask, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mask, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
 def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None) -> Callable:
     """One jitted optimizer step.
@@ -75,12 +87,7 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     (params, opt_state, loss)``. With a mesh, the batch is sharded over 'dp' and
     XLA all-reduces gradients over ICI.
     """
-
-    def step(params, opt_state, x, y, mask, rng):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mask, rng)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    step = _step_body(loss_fn, optimizer)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
@@ -144,13 +151,12 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
         xb, yb, mb = reshape_b(data_e), reshape_b(labels_e), reshape_b(mask_e)
         step_rngs = jax.random.split(rng, num_batches)
+        step = _step_body(loss_fn, optimizer)
 
         def body(carry, batch):
             params, opt_state = carry
             x, y, m, r = batch
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, y, m, r)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            params, opt_state, loss = step(params, opt_state, x, y, m, r)
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(body, (params, opt_state),

@@ -368,6 +368,24 @@ def _infer_elementwise(node, ins):
     return ins[0]
 
 
+def _infer_broadcast(node, ins):
+    """Numpy-style broadcast of input shapes (None dims stay None)."""
+    out: List[Optional[int]] = []
+    rank = max(len(s) for s in ins)
+    shapes = [(None,) * (rank - len(s)) + tuple(s) for s in ins]
+    for dims in zip(*shapes):
+        known = [d for d in dims if d is not None and d != 1]
+        if known and any(k != known[0] for k in known):
+            raise ValueError(f"{node.op} {node.name!r}: shapes {ins} do not broadcast")
+        if known:
+            out.append(known[0])
+        elif all(d == 1 for d in dims):
+            out.append(1)
+        else:
+            out.append(None)
+    return tuple(out)
+
+
 def _infer_argmax(node, ins):
     ax = node.attrs.get("axis", 1)
     s = list(ins[0])
@@ -527,9 +545,9 @@ OPS: Dict[str, _OpDef] = {
     "dropout": _OpDef(_infer_elementwise, _eval_dropout),
     "argmax": _OpDef(_infer_argmax,
                      lambda n, i, c: jnp.argmax(i[0], axis=n.attrs.get("axis", 1)).astype(jnp.float32)),
-    "add": _OpDef(_infer_elementwise, lambda n, i, c: i[0] + i[1]),
-    "subtract": _OpDef(_infer_elementwise, lambda n, i, c: i[0] - i[1]),
-    "multiply": _OpDef(_infer_elementwise, lambda n, i, c: i[0] * i[1]),
+    "add": _OpDef(_infer_broadcast, lambda n, i, c: i[0] + i[1]),
+    "subtract": _OpDef(_infer_broadcast, lambda n, i, c: i[0] - i[1]),
+    "multiply": _OpDef(_infer_broadcast, lambda n, i, c: i[0] * i[1]),
     "matmul": _OpDef(_infer_matmul,
                      lambda n, i, c: jnp.matmul(_cast(i[0], c.compute_dtype),
                                                 _cast(i[1], c.compute_dtype),
@@ -641,7 +659,8 @@ class GraphModel:
               train: bool = False, rng=None) -> Dict[str, jax.Array]:
         """Evaluate the graph. ``feeds`` keys may use ':0' suffixes; so may outputs."""
         norm_feeds = {k.split(":")[0]: v for k, v in feeds.items()}
-        target_ids = [self.graphdef.resolve(o) for o in outputs]
+        target_ids = [o if isinstance(o, int) else self.graphdef.resolve(o)
+                      for o in outputs]
         ctx = _EvalCtx(params, norm_feeds, train, rng, self.compute_dtype)
         values: Dict[int, Any] = {}
         for node in self._needed(target_ids):
@@ -659,13 +678,11 @@ class GraphModel:
         if not self.graphdef.losses:
             raise ValueError("graph has no registered losses; use a loss op from "
                              "sparkflow_tpu.nn (softmax_cross_entropy, mean_squared_error, ...)")
-        names = [f"__loss_{i}" for i in range(len(self.graphdef.losses))]
-        for nm, nid in zip(names, self.graphdef.losses):
-            self.graphdef.aliases.setdefault(nm, nid)
-        outs = self.apply(params, feeds, names, train=train, rng=rng)
-        total = outs[names[0]]
-        for nm in names[1:]:
-            total = total + outs[nm]
+        outs = self.apply(params, feeds, self.graphdef.losses, train=train, rng=rng)
+        vals = list(outs.values())
+        total = vals[0]
+        for v in vals[1:]:
+            total = total + v
         return total
 
 
@@ -688,14 +705,20 @@ def params_to_list(model: GraphModel, params: Dict[str, Dict[str, Any]]) -> List
 
 
 def list_to_params(model: GraphModel, weights: Sequence[np.ndarray]):
+    specs = model.param_specs()
+    needed = sum(len(p) for p in specs.values())
+    if needed != len(weights):
+        raise ValueError(f"weight list has {len(weights)} arrays; model needs {needed}")
     params = {}
     i = 0
-    for lname, pspec in model.param_specs().items():
+    for lname, pspec in specs.items():
         layer = {}
-        for pname in pspec:
-            layer[pname] = jnp.asarray(weights[i])
+        for pname, (shape, _init) in pspec.items():
+            w = jnp.asarray(weights[i])
+            if tuple(w.shape) != tuple(shape):
+                raise ValueError(f"weight {i} for {lname}/{pname} has shape "
+                                 f"{tuple(w.shape)}, expected {tuple(shape)}")
+            layer[pname] = w
             i += 1
         params[lname] = layer
-    if i != len(weights):
-        raise ValueError(f"weight list has {len(weights)} arrays; model needs {i}")
     return params

@@ -1,0 +1,95 @@
+"""``HogwildTrainer``: the ``HogwildSparkModel``-shaped direct training entry.
+
+The reference lets users bypass the Estimator and train an RDD of
+``(features, label)`` pairs directly (``HogwildSparkModel(...).train(rdd)``,
+``sparkflow/HogwildSparkModel.py:110-143,246-266``; exercised by
+``tests/dl_runner.py:187-214``). This class keeps that constructor surface —
+including the parameter-server-era arguments — and returns the trained flat
+weight list. There is no server: ``master_url``, ``serverStartup`` and ``port``
+are accepted and ignored (no process to spawn, no fixed 8-second startup sleep
+— an anti-feature per SURVEY.md), and ``stop_server`` is a no-op kept for
+try/except cleanup code written against the reference.
+
+Also exported under the reference's class name ``HogwildSparkModel``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import optax
+
+from .ml_util import handle_features
+from .optimizers import build_optimizer
+from .parallel.mesh import default_mesh
+from .trainer import Trainer
+
+
+class HogwildTrainer:
+    def __init__(self,
+                 tensorflowGraph: Optional[str] = None,
+                 iters: int = 1000,
+                 tfInput: Optional[str] = None,
+                 tfLabel: Optional[str] = None,
+                 optimizer: Any = None,
+                 master_url: Optional[str] = None,   # ignored: no HTTP server
+                 serverStartup: int = 8,             # ignored: nothing to wait for
+                 acquire_lock: bool = False,         # no-op under sync all-reduce
+                 mini_batch: int = -1,
+                 mini_stochastic_iters: int = -1,
+                 shuffle: bool = True,
+                 verbose: int = 0,
+                 partition_shuffles: int = 1,
+                 loss_callback: Optional[Callable] = None,
+                 port: int = 5000,                   # ignored: no port to bind
+                 mesh=None):
+        if tensorflowGraph is None:
+            raise ValueError("tensorflowGraph (JSON graph spec) is required")
+        if optimizer is None:
+            optimizer = build_optimizer("adam", 0.01, None)
+        elif isinstance(optimizer, str):
+            optimizer = build_optimizer(optimizer, 0.01, None)
+        elif not isinstance(optimizer, optax.GradientTransformation):
+            raise TypeError(
+                "optimizer must be an optax.GradientTransformation or a name; "
+                "TF optimizer objects do not exist in this framework — build one "
+                "with sparkflow_tpu.optimizers.build_optimizer")
+        self._trainer = Trainer(
+            tensorflowGraph, tfInput, tfLabel,
+            optimizer=optimizer,
+            iters=iters,
+            mini_batch_size=mini_batch,
+            mini_stochastic_iters=mini_stochastic_iters,
+            shuffle_per_iter=shuffle,
+            partition_shuffles=partition_shuffles,
+            verbose=verbose,
+            loss_callback=loss_callback,
+            acquire_lock=acquire_lock,
+            mesh=mesh if mesh is not None else default_mesh(),
+        )
+        self.tfLabel = tfLabel
+        self.weights: Optional[List[np.ndarray]] = None
+
+    def train(self, rdd) -> List[np.ndarray]:
+        """Train on an RDD (or any iterable) of ``(features, label)`` pairs —
+        bare features when unsupervised — and return the flat weight list
+        (reference ``HogwildSparkModel.train``, ``HogwildSparkModel.py:246-269``)."""
+        items = rdd.collect() if hasattr(rdd, "collect") else list(rdd)
+        features, labels = handle_features(items,
+                                           is_supervised=self.tfLabel is not None)
+        self._trainer.fit(features, labels)
+        self.weights = self._trainer.weights_list()
+        return self.weights
+
+    def stop_server(self) -> None:
+        """No server exists; kept so reference-style cleanup code runs
+        (``tests/dl_runner.py:209-214``)."""
+
+    # reference attribute some callers poke at
+    @property
+    def server(self):
+        return None
+
+
+HogwildSparkModel = HogwildTrainer

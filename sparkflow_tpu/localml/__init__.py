@@ -1,0 +1,39 @@
+"""localml: a pyspark-ml-compatible local engine.
+
+The reference framework's public surface is the Spark ML API — ``Estimator`` /
+``Model`` / ``Pipeline`` with ``Param``s (``sparkflow/tensorflow_async.py``). This
+package provides an API-compatible local implementation of the subset sparkflow
+uses, so the TPU framework runs standalone (no JVM, no pyspark install) with the
+*same user code*; when pyspark is importable, :mod:`sparkflow_tpu.compat` selects
+the real pyspark classes instead and this package is unused.
+
+Implemented subset (names and behavior match pyspark 2.4-3.x where the reference
+touches them):
+
+- ``param``:       ``Param``, ``Params``, ``TypeConverters``, ``keyword_only``
+- ``base``:        ``Estimator``, ``Transformer``, ``Model``, ``Identifiable``,
+                   ``MLReadable``, ``MLWritable``
+- ``linalg``:      ``Vectors``, ``DenseVector``, ``SparseVector``
+- ``sql``:         ``Row``, ``DataFrame``, ``RDD``, ``LocalSession``, ``functions.rand``
+- ``feature``:     ``VectorAssembler``, ``OneHotEncoder``, ``Normalizer``
+- ``pipeline``:    ``Pipeline``, ``PipelineModel``
+- ``evaluation``:  ``MulticlassClassificationEvaluator``
+"""
+
+from .param import Param, Params, TypeConverters, keyword_only
+from .base import Estimator, Transformer, Model, Identifiable, MLReadable, MLWritable
+from .linalg import Vectors, DenseVector, SparseVector
+from .sql import Row, DataFrame, RDD, LocalSession
+from .feature import VectorAssembler, OneHotEncoder, Normalizer
+from .pipeline import Pipeline, PipelineModel
+from .evaluation import MulticlassClassificationEvaluator
+
+__all__ = [
+    "Param", "Params", "TypeConverters", "keyword_only",
+    "Estimator", "Transformer", "Model", "Identifiable", "MLReadable", "MLWritable",
+    "Vectors", "DenseVector", "SparseVector",
+    "Row", "DataFrame", "RDD", "LocalSession",
+    "VectorAssembler", "OneHotEncoder", "Normalizer",
+    "Pipeline", "PipelineModel",
+    "MulticlassClassificationEvaluator",
+]

@@ -1,0 +1,44 @@
+"""Evaluators: the subset the reference examples use
+(``MulticlassClassificationEvaluator`` with accuracy,
+``examples/simple_dnn.py:71-74``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Param, Params, TypeConverters, keyword_only, HasLabelCol, HasPredictionCol
+
+
+class MulticlassClassificationEvaluator(HasLabelCol, HasPredictionCol):
+    metricName = Param(Params._dummy(), "metricName", "metric name",
+                       typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, labelCol="label", predictionCol="prediction",
+                 metricName="f1"):
+        super().__init__()
+        self._setDefault(labelCol="label", predictionCol="prediction", metricName="f1")
+        kwargs = self._input_kwargs
+        self._set(**kwargs)
+
+    def evaluate(self, dataset) -> float:
+        label_col = self.getOrDefault(self.labelCol)
+        pred_col = self.getOrDefault(self.predictionCol)
+        metric = self.getOrDefault(self.metricName)
+        y = np.array([float(r[label_col]) for r in dataset.collect()])
+        p = np.array([float(r[pred_col]) for r in dataset.collect()])
+        if metric == "accuracy":
+            return float((y == p).mean()) if len(y) else 0.0
+        if metric == "f1":  # weighted f1
+            classes = np.unique(np.concatenate([y, p]))
+            f1s, weights = [], []
+            for c in classes:
+                tp = float(((p == c) & (y == c)).sum())
+                fp = float(((p == c) & (y != c)).sum())
+                fn = float(((p != c) & (y == c)).sum())
+                prec = tp / (tp + fp) if tp + fp else 0.0
+                rec = tp / (tp + fn) if tp + fn else 0.0
+                f1s.append(2 * prec * rec / (prec + rec) if prec + rec else 0.0)
+                weights.append(float((y == c).sum()))
+            return float(np.average(f1s, weights=weights)) if weights else 0.0
+        raise ValueError(f"unsupported metric {metric!r}")

@@ -1,0 +1,109 @@
+"""Feature transformers: the ``pyspark.ml.feature`` subset the reference examples
+use (``VectorAssembler``, ``OneHotEncoder``, ``Normalizer`` — see reference
+``examples/simple_dnn.py:40-41``, ``examples/autoencoder_example.py:26-27``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .base import Transformer
+from .linalg import DenseVector, SparseVector, Vectors, vector_to_array
+from .param import Param, Params, TypeConverters, keyword_only, HasInputCol, HasOutputCol
+from .sql import DataFrame, Row
+
+
+class VectorAssembler(Transformer, HasInputCol, HasOutputCol):
+    """Concatenates numeric / vector columns into one DenseVector column."""
+
+    inputCols = Param(Params._dummy(), "inputCols", "input column names",
+                      typeConverter=TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, inputCols=None, outputCol=None):
+        super().__init__()
+        kwargs = self._input_kwargs
+        self._set(**kwargs)
+
+    def getInputCols(self) -> List[str]:
+        return self.getOrDefault(self.inputCols)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_cols = self.getInputCols()
+        out_col = self.getOrDefault(self.outputCol)
+        rows = []
+        for r in dataset.collect():
+            parts = [vector_to_array(r[c]) for c in in_cols]
+            vec = Vectors.dense(np.concatenate(parts))
+            rows.append(Row(**{**r.asDict(), out_col: vec}))
+        cols = dataset.columns + ([out_col] if out_col not in dataset.columns else [])
+        return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class OneHotEncoder(Transformer, HasInputCol, HasOutputCol):
+    """Category index -> one-hot sparse vector (pyspark 2.x OneHotEncoder
+    semantics: transform-only; vector size inferred as max(index)+1; dropLast
+    drops the final category — the reference uses ``dropLast=False``,
+    ``examples/simple_dnn.py:41``)."""
+
+    dropLast = Param(Params._dummy(), "dropLast", "drop the last category",
+                     typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, dropLast=True):
+        super().__init__()
+        self._setDefault(dropLast=True)
+        kwargs = self._input_kwargs
+        self._set(**kwargs)
+
+    def getDropLast(self) -> bool:
+        return self.getOrDefault(self.dropLast)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        drop_last = self.getDropLast()
+        values = [int(r[in_col]) for r in dataset.collect()]
+        size = (max(values) + 1) if values else 0
+        if drop_last:
+            size -= 1
+        rows = []
+        for r, v in zip(dataset.collect(), values):
+            if v < size:
+                vec = SparseVector(size, [v], [1.0])
+            else:  # dropped last category encodes as all-zeros
+                vec = SparseVector(size, [], [])
+            rows.append(Row(**{**r.asDict(), out_col: vec}))
+        cols = dataset.columns + ([out_col] if out_col not in dataset.columns else [])
+        return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class Normalizer(Transformer, HasInputCol, HasOutputCol):
+    """Scale each vector to unit p-norm (reference autoencoder example uses
+    p=1.0, ``examples/autoencoder_example.py:27``)."""
+
+    p = Param(Params._dummy(), "p", "norm order", typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, p=2.0):
+        super().__init__()
+        self._setDefault(p=2.0)
+        kwargs = self._input_kwargs
+        self._set(**kwargs)
+
+    def getP(self) -> float:
+        return self.getOrDefault(self.p)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        p = self.getP()
+        rows = []
+        for r in dataset.collect():
+            arr = vector_to_array(r[in_col])
+            norm = np.linalg.norm(arr, ord=p)
+            vec = Vectors.dense(arr / norm if norm > 0 else arr)
+            rows.append(Row(**{**r.asDict(), out_col: vec}))
+        cols = dataset.columns + ([out_col] if out_col not in dataset.columns else [])
+        return DataFrame(rows, cols, dataset.num_partitions)

@@ -1,0 +1,296 @@
+"""Row / RDD / DataFrame / session: the ``pyspark.sql`` subset sparkflow touches.
+
+The reference drives training through ``df.rdd.map``, ``coalesce``,
+``foreachPartition`` and inference through ``rdd.mapPartitions(...).toDF()``
+(``sparkflow/tensorflow_async.py:90-99,290-291``; ``HogwildSparkModel.py:259``).
+This local engine keeps those exact call shapes over in-process lists, with
+logical partitions standing in for Spark executors — the multi-device mesh is
+the real parallelism substrate underneath.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import random as _random
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Row:
+    """Named-field record, pyspark-Row-compatible (attr + item access, asDict)."""
+
+    __slots__ = ("__fields__", "__values__")
+
+    def __init__(self, **kwargs):
+        object.__setattr__(self, "__fields__", list(kwargs.keys()))
+        object.__setattr__(self, "__values__", list(kwargs.values()))
+
+    def asDict(self) -> Dict[str, Any]:
+        return dict(zip(self.__fields__, self.__values__))
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self.__values__[key]
+        try:
+            return self.__values__[self.__fields__.index(key)]
+        except ValueError:
+            raise KeyError(key)
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        try:
+            return self.__values__[self.__fields__.index(name)]
+        except ValueError:
+            raise AttributeError(name)
+
+    def __contains__(self, key):
+        return key in self.__fields__
+
+    def __len__(self):
+        return len(self.__values__)
+
+    def __iter__(self):
+        return iter(self.__values__)
+
+    def __eq__(self, other):
+        return isinstance(other, Row) and self.asDict() == other.asDict()
+
+    def __repr__(self):
+        kv = ", ".join(f"{f}={v!r}" for f, v in zip(self.__fields__, self.__values__))
+        return f"Row({kv})"
+
+
+def _slice(items: List[Any], n: int) -> List[List[Any]]:
+    n = max(1, min(n, len(items)) if items else 1)
+    base, extra = divmod(len(items), n)
+    out, i = [], 0
+    for k in range(n):
+        size = base + (1 if k < extra else 0)
+        out.append(items[i:i + size])
+        i += size
+    return out
+
+
+class RDD:
+    """A list with logical partitions; mirrors the RDD methods sparkflow uses."""
+
+    def __init__(self, items: List[Any], num_partitions: int = 1):
+        self.items = list(items)
+        self.num_partitions = max(1, num_partitions)
+
+    # -- transforms ---------------------------------------------------------
+
+    def map(self, f: Callable) -> "RDD":
+        return RDD([f(x) for x in self.items], self.num_partitions)
+
+    def mapPartitions(self, f: Callable) -> "RDD":
+        out: List[Any] = []
+        for part in _slice(self.items, self.num_partitions):
+            out.extend(f(iter(part)))
+        return RDD(out, self.num_partitions)
+
+    def foreachPartition(self, f: Callable) -> None:
+        for part in _slice(self.items, self.num_partitions):
+            f(iter(part))
+
+    def coalesce(self, n: int) -> "RDD":
+        return RDD(self.items, min(self.num_partitions, max(1, n)))
+
+    def repartition(self, n: int) -> "RDD":
+        items = list(self.items)
+        _random.Random(17).shuffle(items)
+        return RDD(items, max(1, n))
+
+    # -- actions ------------------------------------------------------------
+
+    def collect(self) -> List[Any]:
+        return list(self.items)
+
+    def count(self) -> int:
+        return len(self.items)
+
+    def getNumPartitions(self) -> int:
+        return self.num_partitions
+
+    def toDF(self, schema: Optional[Sequence[str]] = None) -> "DataFrame":
+        if not self.items:
+            return DataFrame([], list(schema) if schema else [])
+        rows = [x if isinstance(x, Row) else Row(**x) if isinstance(x, dict)
+                else Row(**{c: v for c, v in zip(schema, x)}) for x in self.items]
+        return DataFrame(rows, rows[0].__fields__, self.num_partitions)
+
+
+class _RandOrder:
+    """Sentinel returned by functions.rand() for orderBy-shuffles."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.seed = seed
+
+
+class functions:
+    @staticmethod
+    def rand(seed: Optional[int] = None) -> _RandOrder:
+        return _RandOrder(seed)
+
+
+class DataFrame:
+    """Immutable list-of-Rows table with logical partitions."""
+
+    def __init__(self, rows: List[Row], columns: List[str], num_partitions: int = 4):
+        self._rows = rows
+        self.columns = list(columns)
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def rdd(self) -> RDD:
+        return RDD(self._rows, self.num_partitions)
+
+    def select(self, *cols) -> "DataFrame":
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = tuple(cols[0])
+        rows = [Row(**{c: r[c] for c in cols}) for r in self._rows]
+        return DataFrame(rows, list(cols), self.num_partitions)
+
+    def withColumn(self, name: str, values: Sequence[Any]) -> "DataFrame":
+        """localml extension: attach a computed column (no Column expressions)."""
+        rows = [Row(**{**r.asDict(), name: v}) for r, v in zip(self._rows, values)]
+        cols = self.columns + ([name] if name not in self.columns else [])
+        return DataFrame(rows, cols, self.num_partitions)
+
+    def orderBy(self, *exprs) -> "DataFrame":
+        rows = list(self._rows)
+        if exprs and isinstance(exprs[0], _RandOrder):
+            _random.Random(exprs[0].seed).shuffle(rows)
+        elif exprs:
+            rows.sort(key=lambda r: tuple(r[c] for c in exprs))
+        return DataFrame(rows, self.columns, self.num_partitions)
+
+    def repartition(self, n: int) -> "DataFrame":
+        return DataFrame(self._rows, self.columns, max(1, n))
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return DataFrame(self._rows, self.columns,
+                         min(self.num_partitions, max(1, n)))
+
+    def collect(self) -> List[Row]:
+        return list(self._rows)
+
+    def take(self, n: int) -> List[Row]:
+        return self._rows[:n]
+
+    def first(self) -> Optional[Row]:
+        return self._rows[0] if self._rows else None
+
+    def count(self) -> int:
+        return len(self._rows)
+
+    def show(self, n: int = 20) -> None:
+        print(" | ".join(self.columns))
+        for r in self._rows[:n]:
+            print(" | ".join(str(r[c]) for c in self.columns))
+
+    def __repr__(self):
+        return f"DataFrame[{', '.join(self.columns)}] ({len(self._rows)} rows)"
+
+
+class _CsvReader:
+    def __init__(self, session):
+        self._session = session
+        self._options: Dict[str, Any] = {}
+
+    def option(self, key: str, value) -> "_CsvReader":
+        self._options[str(key).lower()] = value
+        return self
+
+    def csv(self, path: str) -> DataFrame:
+        infer = str(self._options.get("inferschema", "false")).lower() == "true"
+        header = str(self._options.get("header", "false")).lower() == "true"
+        rows: List[Row] = []
+        with open(path, newline="") as f:
+            reader = _csv.reader(f)
+            cols: Optional[List[str]] = None
+            for rec in reader:
+                if cols is None:
+                    cols = rec if header else [f"_c{i}" for i in range(len(rec))]
+                    if header:
+                        continue
+                vals = [_parse(v) if infer else v for v in rec]
+                rows.append(Row(**dict(zip(cols, vals))))
+        return DataFrame(rows, cols or [], self._session._default_parallelism)
+
+
+def _parse(s: str):
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+class _SessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, Any] = {}
+        self._master = "local[1]"
+
+    def appName(self, name: str) -> "_SessionBuilder":
+        self._conf["app.name"] = name
+        return self
+
+    def master(self, m: str) -> "_SessionBuilder":
+        self._master = m
+        return self
+
+    def config(self, key: str, value) -> "_SessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def getOrCreate(self) -> "LocalSession":
+        par = 1
+        if self._master.startswith("local["):
+            spec = self._master[6:-1]
+            par = 4 if spec == "*" else int(spec)
+        return LocalSession(self._conf, par)
+
+
+class LocalSession:
+    """Stands in for SparkSession: createDataFrame + read.csv."""
+
+    builder = None  # set below (class property pattern like SparkSession.builder)
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None, parallelism: int = 4):
+        self.conf = conf or {}
+        self._default_parallelism = parallelism
+
+    @property
+    def read(self) -> _CsvReader:
+        return _CsvReader(self)
+
+    def createDataFrame(self, data, schema: Optional[Sequence[str]] = None) -> DataFrame:
+        rows: List[Row] = []
+        for item in data:
+            if isinstance(item, Row):
+                rows.append(item)
+            elif isinstance(item, dict):
+                rows.append(Row(**item))
+            else:  # tuple/list + schema
+                if schema is None:
+                    raise ValueError("schema required for tuple data")
+                rows.append(Row(**dict(zip(schema, item))))
+        cols = list(schema) if schema else (rows[0].__fields__ if rows else [])
+        return DataFrame(rows, cols, self._default_parallelism)
+
+    def stop(self):
+        pass
+
+
+class _BuilderAccessor:
+    def __get__(self, obj, objtype=None):
+        return _SessionBuilder()
+
+
+LocalSession.builder = _BuilderAccessor()

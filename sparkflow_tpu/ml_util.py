@@ -1,0 +1,132 @@
+"""Weight serialization, inference kernel, and data marshalling.
+
+Mirrors ``sparkflow/ml_util.py`` function-for-function, re-based on JAX:
+
+- weights travel as a JSON list of nested lists in graph-node order — the same
+  wire format as the reference (``sparkflow/ml_util.py:31-40``), with the flat
+  order defined by :func:`sparkflow_tpu.graphdef.params_to_list` standing in for
+  ``tf.trainable_variables`` order;
+- :func:`predict_func` is the per-partition inference kernel
+  (``sparkflow/ml_util.py:54-83``): rebuilds the model from JSON, runs the named
+  output tensor, appends the prediction column (float for scalar outputs,
+  ``Vectors.dense`` for vector outputs). Unlike the reference it runs in fixed
+  -size chunks rather than one partition-sized batch (OOM anti-feature,
+  SURVEY.md §"anti-features");
+- the set-weights path has no analog of the reference's graph-growing
+  ``tensorflow_set_weights`` hazard (``ml_util.py:16-28``): params are just a
+  pytree value.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .compat import Row, Vectors
+from .core import make_predict_fn, predict_in_chunks
+from .graphdef import GraphModel, list_to_params, params_to_list
+from .localml.linalg import vector_to_array
+
+
+def get_weights(model: GraphModel, params) -> List[np.ndarray]:
+    """Params pytree -> flat weight list (``tensorflow_get_weights`` analog)."""
+    return params_to_list(model, params)
+
+
+def set_weights(model: GraphModel, weights: List[np.ndarray]):
+    """Flat weight list -> params pytree (``tensorflow_set_weights`` analog —
+    but pure: returns a new pytree instead of mutating a session graph)."""
+    return list_to_params(model, weights)
+
+
+def convert_weights_to_json(weights: List[np.ndarray]) -> str:
+    return json.dumps([np.asarray(w).tolist() for w in weights])
+
+
+def convert_json_to_weights(json_weights: str) -> List[np.ndarray]:
+    return [np.asarray(x, dtype=np.float32) for x in json.loads(json_weights)]
+
+
+def params_to_json(model: GraphModel, params) -> str:
+    return convert_weights_to_json(params_to_list(model, params))
+
+
+def json_to_params(model: GraphModel, json_weights: str):
+    return list_to_params(model, convert_json_to_weights(json_weights))
+
+
+# ---------------------------------------------------------------------------
+# Inference kernel
+# ---------------------------------------------------------------------------
+
+_PREDICT_CACHE: Dict[Tuple[int, str, Optional[str], float], Any] = {}
+
+
+def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
+                       tf_dropout: Optional[str], dropout_value: float):
+    """Cache (model, predict_fn) across partitions — the reference rebuilt the
+    whole session per partition (``ml_util.py:61-68``); one compiled program
+    serves all partitions here."""
+    key = (hash(graph_json), tf_output, tf_dropout, dropout_value)
+    if key not in _PREDICT_CACHE:
+        model = GraphModel.from_json(graph_json)
+        fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)
+        _PREDICT_CACHE[key] = (model, fn)
+    return _PREDICT_CACHE[key]
+
+
+def predict_func(rows: Iterable, graph_json: str, prediction: str,
+                 graph_weights: str, inp: str, activation: str, tf_input: str,
+                 tf_dropout: Optional[str] = None, to_keep_dropout: bool = False,
+                 chunk_size: int = 4096) -> List:
+    """Per-partition inference (same signature/meaning as
+    ``sparkflow/ml_util.py:54``). ``activation`` is the output tensor name."""
+    row_dicts = [r.asDict() for r in rows]
+    if not row_dicts:
+        return []
+    dropout_v = 1.0 if (tf_dropout is not None and to_keep_dropout) else 0.0
+    model, fn = _cached_predict_fn(graph_json, activation, tf_input,
+                                   tf_dropout, dropout_v)
+    params = json_to_params(model, graph_weights)
+    x = np.stack([vector_to_array(rd[inp]) for rd in row_dicts]).astype(np.float32)
+    preds = predict_in_chunks(fn, params, x, chunk_size)
+    for rd, p in zip(row_dicts, preds):
+        arr = np.asarray(p)
+        if arr.ndim == 0 or arr.size == 1:
+            rd[prediction] = float(arr.reshape(()))
+        else:
+            rd[prediction] = Vectors.dense(arr)
+    return [Row(**rd) for rd in row_dicts]
+
+
+# ---------------------------------------------------------------------------
+# Data marshalling (reference ml_util.py:86-134)
+# ---------------------------------------------------------------------------
+
+
+def handle_features(data: Iterable, is_supervised: bool = False
+                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Materialize an iterator of (features, label) / features into arrays.
+    Scalar labels wrap to ``[y]`` (reference ``ml_util.py:86-101``)."""
+    features, labels = [], []
+    for item in data:
+        if is_supervised:
+            x, y = item
+            if isinstance(y, (int, float)):
+                labels.append([y])
+            else:
+                labels.append(vector_to_array(y))
+            features.append(vector_to_array(x) if not isinstance(x, np.ndarray) else x)
+        else:
+            features.append(vector_to_array(item) if not isinstance(item, np.ndarray) else item)
+    f = np.asarray(features, dtype=np.float32)
+    l = np.asarray(labels, dtype=np.float32) if is_supervised else None
+    return f, l
+
+
+def handle_shuffle(features: np.ndarray, labels: Optional[np.ndarray]
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    idx = np.random.permutation(features.shape[0])
+    return features[idx], labels[idx] if labels is not None else None

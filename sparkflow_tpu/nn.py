@@ -59,11 +59,20 @@ class Sym:
     def __add__(self, other):
         return add(self, other)
 
+    def __radd__(self, other):
+        return add(other, self)
+
     def __sub__(self, other):
         return subtract(self, other)
 
+    def __rsub__(self, other):
+        return subtract(other, self)
+
     def __mul__(self, other):
         return multiply(self, other)
+
+    def __rmul__(self, other):
+        return multiply(other, self)
 
     def __repr__(self):
         return f"Sym({self.name})"
@@ -115,15 +124,25 @@ def _op(op: str, inputs: Sequence[Any], attrs: dict, name: Optional[str] = None)
 
 # -- inputs ------------------------------------------------------------------
 
-def placeholder(shape=None, name: Optional[str] = None, dtype: str = "float32") -> Sym:
+def placeholder(*args, name: Optional[str] = None, dtype: str = "float32",
+                shape=None) -> Sym:
     """Declare a model input. ``shape=[None, d]`` — None marks the batch dim.
 
-    Accepts ``placeholder('float', shape=[...])``-style dtype-first calls too,
-    since TF1 model functions are often written that way
-    (reference ``examples/autoencoder_example.py:11``).
+    Positional forms accepted (TF1 model functions are written both ways):
+    ``placeholder([None, d], 'x')`` (shape-first, this framework's native form)
+    and ``placeholder('float', [None, d], 'x')`` / ``placeholder('float',
+    shape=[...], name=...)`` (tf.placeholder's dtype-first ordering, reference
+    ``examples/autoencoder_example.py:11``).
     """
-    if isinstance(shape, str):  # tf.placeholder('float', shape=..., name=...) ordering
-        shape, dtype = None, shape
+    args = list(args)
+    if args and isinstance(args[0], str):  # dtype-first (TF1 ordering)
+        dtype = args.pop(0)
+    if args and isinstance(args[0], (list, tuple)):
+        shape = args.pop(0)
+    if args and isinstance(args[0], str):  # trailing positional name
+        name = args.pop(0)
+    if args:
+        raise TypeError(f"placeholder: unexpected positional arguments {args!r}")
     if shape is None:
         raise ValueError("placeholder requires a shape")
     if dtype in ("float", "float32", "f32"):

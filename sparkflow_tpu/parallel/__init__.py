@@ -1,0 +1,20 @@
+"""Device-mesh parallelism: the distributed backend of the framework.
+
+The reference's only distributed machinery is a star-topology HTTP parameter
+server (``sparkflow/HogwildSparkModel.py``; SURVEY.md §5 "Distributed
+communication backend"). Here the backend is XLA collectives over the TPU
+fabric: a :class:`jax.sharding.Mesh` with named axes
+
+- ``dp``  — data parallelism (batch sharding, gradient all-reduce),
+- ``fsdp`` — parameter/optimizer sharding (ZeRO-style, reduce_scatter grads),
+- ``tp``  — tensor parallelism (megatron-style sharded matmuls),
+- ``sp``  — sequence/context parallelism (ring attention over ICI),
+
+plus multi-host process groups via ``jax.distributed``. Collectives ride ICI
+within a slice and DCN across slices; there is no parameter server process.
+"""
+
+from .mesh import default_mesh, make_mesh, mesh_axis_size
+from . import collectives
+
+__all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives"]

@@ -1,0 +1,38 @@
+"""Thin wrappers over XLA collectives used throughout the framework.
+
+These are the TPU-native replacement for the reference's HTTP weight/gradient
+transport (``GET /parameters`` / ``POST /update``,
+``sparkflow/HogwildSparkModel.py:22-35``): gradient merge is a ``psum`` compiled
+into the train step, riding ICI/DCN — weights never leave the device mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def psum_mean(tree, axis_name: str):
+    """All-reduce-mean a pytree over a mesh axis (gradient averaging)."""
+    n = jax.lax.psum(1, axis_name)
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name) / n, tree)
+
+
+def psum(tree, axis_name: str):
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute_ring(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the mesh-axis ring (building block of ring
+    attention and pipeline schedules)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)

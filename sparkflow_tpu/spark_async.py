@@ -1,0 +1,299 @@
+"""``SparkAsyncDL`` / ``SparkAsyncDLModel``: the Spark ML estimator surface.
+
+Drop-in equivalents of the reference's public classes
+(``sparkflow/tensorflow_async.py:51-321``) with the identical Param surface —
+18 params on the estimator, 6 on the model — and ``.fit``/``.transform``
+semantics, including unsupervised mode (``tfLabel=None``), the dropout feed
+(``tfDropout``/``toKeepDropout``), and scalar-vs-vector prediction columns.
+
+What changed underneath (the TPU-native part): ``_fit`` no longer spawns a Flask
+parameter server and ship-pickles weights per batch — it stages the dataset onto
+the local device mesh and runs whole-epoch compiled programs with gradient
+all-reduce over ICI (see :mod:`sparkflow_tpu.trainer`). ``acquireLock``,
+``port`` are accepted for API compatibility: lock-free vs locked updates have no
+meaning under synchronous all-reduce, and there is no server to bind a port for.
+
+Also importable as :mod:`sparkflow_tpu.tensorflow_async` for line-for-line
+import compatibility with reference user code.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from .compat import (Estimator, HasInputCol, HasLabelCol, HasPredictionCol,
+                     Identifiable, MLReadable, MLWritable, Model, Param, Params,
+                     TypeConverters, keyword_only)
+from .graphdef import GraphModel
+from .localml.linalg import vector_to_array
+from .ml_util import (convert_weights_to_json, handle_features, predict_func)
+from .optimizers import build_optimizer_from_json
+from .parallel.mesh import default_mesh
+from .pipeline_util import PysparkReaderWriter
+from .trainer import Trainer
+
+
+def build_optimizer(optimizer_name, learning_rate, optimizer_options=None):
+    """Name -> optax transformation (reference ``tensorflow_async.py:17-42``)."""
+    from .optimizers import build_optimizer as _bo
+    return _bo(optimizer_name, learning_rate, optimizer_options)
+
+
+def handle_data(data, inp_col: str, label_col: Optional[str]):
+    """Row -> (features ndarray, label) or bare features when unsupervised
+    (reference ``tensorflow_async.py:45-48``)."""
+    if label_col is None:
+        return np.asarray(vector_to_array(data[inp_col]), dtype=np.float32)
+    return (np.asarray(vector_to_array(data[inp_col]), dtype=np.float32),
+            data[label_col])
+
+
+class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWriter,
+                        MLReadable, MLWritable, Identifiable):
+    """Fitted model: graph JSON + weights JSON as string Params, applied
+    per-partition (reference ``tensorflow_async.py:51-99``)."""
+
+    modelJson = Param(Params._dummy(), "modelJson", "", typeConverter=TypeConverters.toString)
+    modelWeights = Param(Params._dummy(), "modelWeights", "", typeConverter=TypeConverters.toString)
+    tfOutput = Param(Params._dummy(), "tfOutput", "", typeConverter=TypeConverters.toString)
+    tfInput = Param(Params._dummy(), "tfInput", "", typeConverter=TypeConverters.toString)
+    tfDropout = Param(Params._dummy(), "tfDropout", "", typeConverter=TypeConverters.toString)
+    toKeepDropout = Param(Params._dummy(), "toKeepDropout", "", typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self,
+                 inputCol=None,
+                 modelJson=None,
+                 modelWeights=None,
+                 tfInput=None,
+                 tfOutput=None,
+                 tfDropout=None,
+                 toKeepDropout=None,
+                 predictionCol=None):
+        super(SparkAsyncDLModel, self).__init__()
+        self._setDefault(modelJson=None, inputCol='encoded',
+                         predictionCol='predicted', tfOutput=None, tfInput=None,
+                         modelWeights=None, tfDropout=None, toKeepDropout=False)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self,
+                  inputCol=None,
+                  modelJson=None,
+                  modelWeights=None,
+                  tfInput=None,
+                  tfOutput=None,
+                  tfDropout=None,
+                  toKeepDropout=None,
+                  predictionCol=None):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def _transform(self, dataset):
+        inp = self.getOrDefault(self.inputCol)
+        out = self.getOrDefault(self.predictionCol)
+        mod_json = self.getOrDefault(self.modelJson)
+        mod_weights = self.getOrDefault(self.modelWeights)
+        tf_input = self.getOrDefault(self.tfInput)
+        tf_output = self.getOrDefault(self.tfOutput)
+        tf_dropout = self.getOrDefault(self.tfDropout)
+        to_keep_dropout = self.getOrDefault(self.toKeepDropout)
+        return dataset.rdd.mapPartitions(
+            lambda x: predict_func(x, mod_json, out, mod_weights, inp, tf_output,
+                                   tf_input, tf_dropout, to_keep_dropout)).toDF()
+
+
+class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
+                   PysparkReaderWriter, MLReadable, MLWritable, Identifiable):
+    """Estimator with the reference's full 18-Param surface
+    (``tensorflow_async.py:102-210``); ``_fit`` trains on the TPU mesh."""
+
+    tensorflowGraph = Param(Params._dummy(), "tensorflowGraph", "", typeConverter=TypeConverters.toString)
+    tfInput = Param(Params._dummy(), "tfInput", "", typeConverter=TypeConverters.toString)
+    tfOutput = Param(Params._dummy(), "tfOutput", "", typeConverter=TypeConverters.toString)
+    tfLabel = Param(Params._dummy(), "tfLabel", "", typeConverter=TypeConverters.toString)
+    tfOptimizer = Param(Params._dummy(), "tfOptimizer", "", typeConverter=TypeConverters.toString)
+    tfLearningRate = Param(Params._dummy(), "tfLearningRate", "", typeConverter=TypeConverters.toFloat)
+    iters = Param(Params._dummy(), "iters", "", typeConverter=TypeConverters.toInt)
+    partitions = Param(Params._dummy(), "partitions", "", typeConverter=TypeConverters.toInt)
+    miniBatchSize = Param(Params._dummy(), "miniBatchSize", "", typeConverter=TypeConverters.toInt)
+    miniStochasticIters = Param(Params._dummy(), "miniStochasticIters", "", typeConverter=TypeConverters.toInt)
+    verbose = Param(Params._dummy(), "verbose", "", typeConverter=TypeConverters.toInt)
+    acquireLock = Param(Params._dummy(), "acquireLock", "", typeConverter=TypeConverters.toBoolean)
+    shufflePerIter = Param(Params._dummy(), "shufflePerIter", "", typeConverter=TypeConverters.toBoolean)
+    tfDropout = Param(Params._dummy(), "tfDropout", "", typeConverter=TypeConverters.toString)
+    toKeepDropout = Param(Params._dummy(), "toKeepDropout", "", typeConverter=TypeConverters.toBoolean)
+    partitionShuffles = Param(Params._dummy(), "partitionShuffles", "", typeConverter=TypeConverters.toInt)
+    optimizerOptions = Param(Params._dummy(), "optimizerOptions", "", typeConverter=TypeConverters.toString)
+    port = Param(Params._dummy(), "port", "", typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self,
+                 inputCol=None,
+                 tensorflowGraph=None,
+                 tfInput=None,
+                 tfLabel=None,
+                 tfOutput=None,
+                 tfOptimizer=None,
+                 tfLearningRate=None,
+                 iters=None,
+                 predictionCol=None,
+                 partitions=None,
+                 miniBatchSize=None,
+                 miniStochasticIters=None,
+                 acquireLock=None,
+                 shufflePerIter=None,
+                 tfDropout=None,
+                 toKeepDropout=None,
+                 verbose=None,
+                 labelCol=None,
+                 partitionShuffles=None,
+                 optimizerOptions=None,
+                 port=None):
+        """Same parameter meanings as the reference estimator docstring
+        (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
+        accepted no-ops under synchronous all-reduce training."""
+        super(SparkAsyncDL, self).__init__()
+        self._setDefault(inputCol='transformed', tensorflowGraph='',
+                         tfInput='x:0', tfLabel=None, tfOutput='out/Sigmoid:0',
+                         tfOptimizer='adam', tfLearningRate=.01, partitions=5,
+                         miniBatchSize=128, miniStochasticIters=-1,
+                         shufflePerIter=True, tfDropout=None, acquireLock=False,
+                         verbose=0, iters=1000, toKeepDropout=False,
+                         predictionCol='predicted', labelCol=None,
+                         partitionShuffles=1, optimizerOptions=None, port=5000)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self,
+                  inputCol=None,
+                  tensorflowGraph=None,
+                  tfInput=None,
+                  tfLabel=None,
+                  tfOutput=None,
+                  tfOptimizer=None,
+                  tfLearningRate=None,
+                  iters=None,
+                  predictionCol=None,
+                  partitions=None,
+                  miniBatchSize=None,
+                  miniStochasticIters=None,
+                  acquireLock=None,
+                  shufflePerIter=None,
+                  tfDropout=None,
+                  toKeepDropout=None,
+                  verbose=None,
+                  labelCol=None,
+                  partitionShuffles=None,
+                  optimizerOptions=None,
+                  port=None):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    # getters (reference tensorflow_async.py:212-264)
+    def getTensorflowGraph(self):
+        return self.getOrDefault(self.tensorflowGraph)
+
+    def getIters(self):
+        return self.getOrDefault(self.iters)
+
+    def getTfInput(self):
+        return self.getOrDefault(self.tfInput)
+
+    def getTfLabel(self):
+        return self.getOrDefault(self.tfLabel)
+
+    def getTfOutput(self):
+        return self.getOrDefault(self.tfOutput)
+
+    def getTfOptimizer(self):
+        return self.getOrDefault(self.tfOptimizer)
+
+    def getTfLearningRate(self):
+        return self.getOrDefault(self.tfLearningRate)
+
+    def getPartitions(self):
+        return self.getOrDefault(self.partitions)
+
+    def getMiniBatchSize(self):
+        return self.getOrDefault(self.miniBatchSize)
+
+    def getMiniStochasticIters(self):
+        return self.getOrDefault(self.miniStochasticIters)
+
+    def getVerbose(self):
+        return self.getOrDefault(self.verbose)
+
+    def getAcquireLock(self):
+        return self.getOrDefault(self.acquireLock)
+
+    def getShufflePerIter(self):
+        return self.getOrDefault(self.shufflePerIter)
+
+    def getTfDropout(self):
+        return self.getOrDefault(self.tfDropout)
+
+    def getToKeepDropout(self):
+        return self.getOrDefault(self.toKeepDropout)
+
+    def getPartitionShuffles(self):
+        return self.getOrDefault(self.partitionShuffles)
+
+    def getOptimizerOptions(self):
+        return self.getOrDefault(self.optimizerOptions)
+
+    def getPort(self):
+        return self.getOrDefault(self.port)
+
+    def _fit(self, dataset):
+        inp_col = self.getOrDefault(self.inputCol)
+        graph_json = self.getTensorflowGraph()
+        label_col = self.getOrDefault(self.labelCol)
+        tf_label = self.getTfLabel()
+        optimizer_options = self.getOptimizerOptions()
+
+        # DataFrame -> (features, label) pairs; partitions Param shapes the RDD
+        # exactly as the reference does (tensorflow_async.py:290-291), then the
+        # union of partition data is staged onto the device mesh.
+        rdd = dataset.rdd.map(lambda r: handle_data(r, inp_col, label_col))
+        partitions = self.getPartitions()
+        if rdd.getNumPartitions() > partitions:
+            rdd = rdd.coalesce(partitions)
+        items = rdd.collect()
+        features, labels = handle_features(items, is_supervised=label_col is not None)
+
+        optimizer = build_optimizer_from_json(self.getTfOptimizer(),
+                                              self.getTfLearningRate(),
+                                              optimizer_options)
+        trainer = Trainer(
+            graph_json,
+            self.getTfInput(),
+            tf_label,
+            optimizer=optimizer,
+            iters=self.getIters(),
+            mini_batch_size=self.getMiniBatchSize(),
+            mini_stochastic_iters=self.getMiniStochasticIters(),
+            shuffle_per_iter=self.getShufflePerIter(),
+            partition_shuffles=self.getPartitionShuffles(),
+            verbose=self.getVerbose(),
+            dropout_name=self.getTfDropout(),
+            acquire_lock=self.getAcquireLock(),
+            mesh=default_mesh(),
+        )
+        result = trainer.fit(features, labels)
+        weights_json = convert_weights_to_json(trainer.weights_list())
+
+        return SparkAsyncDLModel(
+            inputCol=inp_col,
+            modelJson=graph_json,
+            modelWeights=weights_json,
+            tfOutput=self.getTfOutput(),
+            tfInput=self.getTfInput(),
+            tfDropout=self.getTfDropout(),
+            toKeepDropout=self.getToKeepDropout(),
+            predictionCol=self.getOrDefault(self.predictionCol))

@@ -1,0 +1,12 @@
+"""Import-compatibility alias: ``from sparkflow_tpu.tensorflow_async import
+SparkAsyncDL`` works exactly like the reference's
+``from sparkflow.tensorflow_async import SparkAsyncDL``.
+
+The real implementation lives in :mod:`sparkflow_tpu.spark_async` (there is no
+TensorFlow here — the name is kept purely so reference user code ports by
+swapping the package root)."""
+
+from .spark_async import (SparkAsyncDL, SparkAsyncDLModel, build_optimizer,
+                          handle_data)
+
+__all__ = ["SparkAsyncDL", "SparkAsyncDLModel", "build_optimizer", "handle_data"]

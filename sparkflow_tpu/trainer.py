@@ -167,7 +167,12 @@ class Trainer:
 
         rng = jax.random.PRNGKey(self.seed)
         init_rng, rng = jax.random.split(rng)
-        params = init_params if init_params is not None else self.model.init(init_rng)
+        if init_params is not None:
+            # copy: the epoch program donates its params buffers, which would
+            # invalidate the caller's arrays on TPU
+            params = jax.tree.map(lambda a: jnp.array(a), init_params)
+        else:
+            params = self.model.init(init_rng)
         opt_state = self.optimizer.init(params)
 
         loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
@@ -199,7 +204,10 @@ class Trainer:
         # block until the last step is done for honest timing
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
-        seen = num_batches * batch * it
+        # real examples per epoch: padded rows carry zero weight and don't
+        # count; stochastic mode counts sampled slots (its actual step volume)
+        per_epoch = num_batches * batch if mode == "stochastic" else n
+        seen = per_epoch * it
         self.params = params
         epoch_losses = [float(l) for l in loss_handles]
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
