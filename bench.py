@@ -1,0 +1,81 @@
+"""Headline benchmark: MNIST CNN training throughput (examples/sec) on TPU.
+
+Config matches BASELINE.md's primary metric — the reference's
+``examples/cnn_example.py`` model trained via the framework — against the
+measured single-node Hogwild-proxy baseline in ``BASELINE_MEASURED.json``
+(see ``bench_baseline.py``; the reference publishes no numbers of its own).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import sparkflow_tpu.nn as nn
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.trainer import Trainer
+    from sparkflow_tpu.parallel.mesh import default_mesh
+
+    quick = "--quick" in sys.argv
+
+    def cnn_model():
+        x = nn.placeholder([None, 784], name="x")
+        y = nn.placeholder([None, 10], name="y")
+        xr = nn.reshape(x, [-1, 28, 28, 1])
+        c1 = nn.conv2d(xr, 32, 5, activation="relu")
+        p1 = nn.max_pooling2d(c1, 2, 2)
+        c2 = nn.conv2d(p1, 64, 3, activation="relu")
+        p2 = nn.max_pooling2d(c2, 2, 2)
+        out = nn.dense(nn.flatten(p2), 10, name="out")
+        nn.softmax_cross_entropy(y, out)
+
+    mg = build_graph(cnn_model)
+
+    n = 4096 if quick else 16384
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n)]
+
+    platform = jax.devices()[0].platform
+    # bf16 compute on TPU (f32 accumulation stays on); f32 elsewhere
+    compute_dtype = "bfloat16" if platform == "tpu" else None
+
+    trainer = Trainer(mg, "x:0", "y:0", optimizer="adam",
+                      optimizer_options={"learning_rate": 1e-3},
+                      mini_batch_size=1024, shuffle_per_iter=True,
+                      iters=1, mesh=default_mesh(),
+                      compute_dtype=compute_dtype)
+
+    trainer.fit(x, y)  # warmup epoch: compile + stage data
+
+    iters = 2 if quick else 6
+    trainer.iters = iters
+    res = trainer.fit(x, y, init_params=trainer.params)
+    eps = res.examples_per_sec
+
+    vs_baseline = None
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_MEASURED.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)["baseline_examples_per_sec"]
+        vs_baseline = round(eps / base, 2)
+
+    print(json.dumps({
+        "metric": "mnist_cnn_examples_per_sec",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Measure the reference-equivalent baseline: single-node Hogwild-style CNN
+training throughput on CPU.
+
+The reference (TF 1.10 + Spark 2.4.3) is not installable in this image, so the
+baseline is a faithful CPU proxy of its training loop using torch (CPU): the same
+MNIST CNN, mini-batch SGD-with-adam steps, plus the reference's per-batch
+parameter-server exchange cost — every batch serializes the full gradient list
+and deserializes the full weight list with pickle, exactly the wire work
+``GET /parameters`` / ``POST /update`` did (``sparkflow/HogwildSparkModel.py:
+22-35,57-58,75-76``; loopback HTTP latency excluded, which only favors the
+baseline). Writes BASELINE_MEASURED.json; run once, committed.
+"""
+
+import json
+import pickle
+import time
+
+import numpy as np
+import torch
+import torch.nn as tnn
+import torch.nn.functional as F
+
+torch.manual_seed(0)
+torch.set_num_threads(1)  # reference guidance: --executor cores 1 (README.md:209-213)
+
+
+class RefCNN(tnn.Module):
+    """The cnn_example.py model (examples/cnn_example.py:10-22 in reference)."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = tnn.Conv2d(1, 32, 5)
+        self.c2 = tnn.Conv2d(32, 64, 3)
+        self.fc = tnn.Linear(64 * 5 * 5, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.c1(x)), 2)
+        x = F.max_pool2d(F.relu(self.c2(x)), 2)
+        return self.fc(torch.flatten(x, 1))
+
+
+def measure(batch_size=300, n_batches=12):
+    model = RefCNN()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-4)
+    rs = np.random.RandomState(0)
+    x = torch.tensor(rs.rand(batch_size, 1, 28, 28), dtype=torch.float32)
+    y = torch.tensor(rs.randint(0, 10, batch_size), dtype=torch.long)
+
+    # warmup
+    for _ in range(2):
+        opt.zero_grad()
+        F.cross_entropy(model(x), y).backward()
+        opt.step()
+
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        # per-batch PS wire work the reference pays (weights down, grads up)
+        weights = [p.detach().numpy() for p in model.parameters()]
+        _ = pickle.loads(pickle.dumps(weights, -1))
+        opt.zero_grad()
+        F.cross_entropy(model(x), y).backward()
+        grads = [p.grad.detach().numpy() for p in model.parameters()]
+        _ = pickle.loads(pickle.dumps(grads, -1))
+        opt.step()
+    wall = time.perf_counter() - t0
+    return batch_size * n_batches / wall
+
+
+if __name__ == "__main__":
+    eps = measure()
+    out = {
+        "metric": "mnist_cnn_examples_per_sec",
+        "baseline_examples_per_sec": round(eps, 1),
+        "how": "torch-CPU single-thread proxy of the reference Hogwild loop "
+               "(same CNN, adam, batch 300, full pickle weight+grad round-trip "
+               "per batch; loopback HTTP latency excluded)",
+    }
+    with open("BASELINE_MEASURED.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))

@@ -258,7 +258,13 @@ def _params_dense(node, ins):
 def _eval_dense(node, ins, ctx, p):
     x = _cast(ins[0], ctx.compute_dtype)
     k = _cast(p["kernel"], ctx.compute_dtype)
-    y = jnp.matmul(x, k, preferred_element_type=jnp.float32)
+    # same-dtype operands keep the VJP well-typed; with bf16 compute the TPU
+    # MXU still accumulates in f32 internally. Without a compute dtype, ask
+    # for f32 accumulation explicitly.
+    if ctx.compute_dtype is None:
+        y = jnp.matmul(x, k, preferred_element_type=jnp.float32)
+    else:
+        y = jnp.matmul(x, k)
     if "bias" in p:
         y = y + p["bias"].astype(y.dtype)
     return y
@@ -298,7 +304,7 @@ def _eval_conv2d(node, ins, ctx, p):
     y = jax.lax.conv_general_dilated(
         x, k, window_strides=(sh, sw), padding=pad,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=None if ctx.compute_dtype is not None else jnp.float32)
     if "bias" in p:
         y = y + p["bias"].astype(y.dtype)
     return y
@@ -549,9 +555,10 @@ OPS: Dict[str, _OpDef] = {
     "subtract": _OpDef(_infer_broadcast, lambda n, i, c: i[0] - i[1]),
     "multiply": _OpDef(_infer_broadcast, lambda n, i, c: i[0] * i[1]),
     "matmul": _OpDef(_infer_matmul,
-                     lambda n, i, c: jnp.matmul(_cast(i[0], c.compute_dtype),
-                                                _cast(i[1], c.compute_dtype),
-                                                preferred_element_type=jnp.float32)),
+                     lambda n, i, c: jnp.matmul(
+                         _cast(i[0], c.compute_dtype), _cast(i[1], c.compute_dtype),
+                         preferred_element_type=(jnp.float32 if c.compute_dtype is None
+                                                 else None))),
     "concat": _OpDef(_infer_concat,
                      lambda n, i, c: jnp.concatenate(list(i), axis=n.attrs.get("axis", -1))),
     "layer_norm": _OpDef(_infer_elementwise, None, _params_layer_norm),

@@ -69,7 +69,7 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
     """Cache (model, predict_fn) across partitions — the reference rebuilt the
     whole session per partition (``ml_util.py:61-68``); one compiled program
     serves all partitions here."""
-    key = (hash(graph_json), tf_output, tf_dropout, dropout_value)
+    key = (hash(graph_json), tf_output, tf_input, tf_dropout, dropout_value)
     if key not in _PREDICT_CACHE:
         model = GraphModel.from_json(graph_json)
         fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)

@@ -135,14 +135,22 @@ def placeholder(*args, name: Optional[str] = None, dtype: str = "float32",
     ``examples/autoencoder_example.py:11``).
     """
     args = list(args)
-    if args and isinstance(args[0], str):  # dtype-first (TF1 ordering)
-        dtype = args.pop(0)
-    if args and isinstance(args[0], (list, tuple)):
-        shape = args.pop(0)
-    if args and isinstance(args[0], str):  # trailing positional name
-        name = args.pop(0)
-    if args:
-        raise TypeError(f"placeholder: unexpected positional arguments {args!r}")
+    pos: dict = {}
+    if args and isinstance(args[0], str):  # TF1 ordering: (dtype, shape, name)
+        order = ["dtype", "shape", "name"]
+    else:  # native ordering: (shape, name, dtype)
+        order = ["shape", "name", "dtype"]
+    if len(args) > len(order):
+        raise TypeError(f"placeholder takes at most {len(order)} positional "
+                        f"arguments ({len(args)} given)")
+    for slot, val in zip(order, args):
+        pos[slot] = val
+    for slot, kw in (("shape", shape), ("name", name), ("dtype", dtype)):
+        if slot in pos and kw is not None and slot != "dtype" :
+            raise TypeError(f"placeholder got multiple values for {slot!r}")
+    shape = pos.get("shape", shape)
+    name = pos.get("name", name)
+    dtype = pos.get("dtype", dtype)
     if shape is None:
         raise ValueError("placeholder requires a shape")
     if dtype in ("float", "float32", "f32"):

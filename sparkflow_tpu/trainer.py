@@ -118,6 +118,7 @@ class Trainer:
         self.mesh = mesh
         self.seed = seed
         self.params = None
+        self._epoch_cache = {}  # (batch, num_batches, mode, shuffle) -> compiled epoch
 
     # -- batching plan ------------------------------------------------------
 
@@ -129,11 +130,17 @@ class Trainer:
             dp = int(np.prod([s for name, s in zip(self.mesh.axis_names, self.mesh.devices.shape)
                               if name == "dp"])) or 1
         bs = self.mini_batch_size
-        if bs is None or bs <= 0 or bs >= n:
-            # full-batch mode (reference clamps miniBatchSize > n similarly,
-            # sparkflow/ml_util.py:105-106)
+        stochastic = bool(self.mini_stochastic_iters and self.mini_stochastic_iters > 0)
+        if bs is None or bs <= 0 or (bs >= n and not stochastic):
+            # full-batch mode; an over-large miniBatchSize degenerates to one
+            # full-batch step per epoch...
             batch = -(-n // dp) * dp
             return "full", batch, 1
+        if bs >= n:
+            # ...except in stochastic mode, where the reference clamps the
+            # batch to the dataset and still runs the requested number of
+            # steps per epoch (sparkflow/ml_util.py:105-106)
+            bs = n
         batch = -(-bs // dp) * dp  # round batch up to a multiple of dp shards
         sweeps = -(-n // batch)
         if self.mini_stochastic_iters and self.mini_stochastic_iters > 0:
@@ -175,9 +182,13 @@ class Trainer:
             params = self.model.init(init_rng)
         opt_state = self.optimizer.init(params)
 
-        loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
-        epoch_fn = make_epoch_fn(loss_fn, self.optimizer, batch, num_batches,
-                                 mode, self.shuffle_per_iter, self.mesh)
+        cache_key = (batch, num_batches, mode, self.shuffle_per_iter)
+        if cache_key not in self._epoch_cache:
+            loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
+            self._epoch_cache[cache_key] = make_epoch_fn(
+                loss_fn, self.optimizer, batch, num_batches, mode,
+                self.shuffle_per_iter, self.mesh)
+        epoch_fn = self._epoch_cache[cache_key]
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
         device_args = (jnp.asarray(x_pad), jnp.asarray(y_pad), jnp.asarray(mask))
