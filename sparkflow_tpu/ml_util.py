@@ -71,7 +71,8 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
     serves all partitions here."""
     key = (hash(graph_json), tf_output, tf_input, tf_dropout, dropout_value)
     if key not in _PREDICT_CACHE:
-        model = GraphModel.from_json(graph_json)
+        from .models import model_from_json
+        model = model_from_json(graph_json)
         fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)
         _PREDICT_CACHE[key] = (model, fn)
     return _PREDICT_CACHE[key]

@@ -14,7 +14,6 @@ from typing import List, Optional
 
 import numpy as np
 
-from .graphdef import GraphModel
 from .ml_util import convert_weights_to_json
 from .spark_async import SparkAsyncDLModel
 
@@ -40,7 +39,8 @@ def load_checkpoint_model(checkpoint_path: str,
     """Load saved weights (npz or orbax dir) + a graph spec into a fitted
     ``SparkAsyncDLModel`` — the JAX-native equivalent of the reference's
     ``load_tensorflow_model`` (``tensorflow_model_loader.py:8-32``)."""
-    model = GraphModel.from_json(graph_json)
+    from .models import model_from_json
+    model = model_from_json(graph_json)
     if os.path.isdir(checkpoint_path):
         from .checkpoint import CheckpointManager
         weights = CheckpointManager.load_weights(checkpoint_path, model)

@@ -1,0 +1,29 @@
+"""Model zoo: registry models usable anywhere a graph-DSL model is.
+
+Two model spec formats travel through the framework as JSON strings (the wire
+format the Estimator's ``tensorflowGraph`` Param carries):
+
+1. graph-DSL specs (``sparkflow-tpu-graph``) built by ``build_graph`` — arbitrary
+   user models, executed by :class:`sparkflow_tpu.graphdef.GraphModel`;
+2. registry specs (``sparkflow-tpu-model``) naming a model family + config —
+   the zoo below, hand-written functional JAX with TPU sharding rules
+   (tensor-parallel PartitionSpecs, ring/flash attention).
+
+``model_from_json`` dispatches on the format marker; everything downstream
+(Trainer, predict_func, model_loader) is format-agnostic.
+
+Families: ``mlp``, ``cnn``, ``autoencoder`` (graph-DSL preset builders mirroring
+the reference examples), ``transformer_classifier`` / ``transformer_lm`` (BERT
+-class encoder, flash/ring attention, TP/SP shardings), ``resnet50`` (CIFAR/
+ImageNet residual network, stateless norm).
+"""
+
+from .registry import model_from_json, register_model, build_registry_spec
+from . import presets
+from .transformer import TransformerClassifier, TransformerLM
+from .resnet import ResNet
+
+__all__ = [
+    "model_from_json", "register_model", "build_registry_spec", "presets",
+    "TransformerClassifier", "TransformerLM", "ResNet",
+]

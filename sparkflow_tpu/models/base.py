@@ -1,0 +1,92 @@
+"""Shared plumbing for registry models: the GraphModel duck type.
+
+A registry model exposes the same executable surface as
+:class:`sparkflow_tpu.graphdef.GraphModel` — ``init``, ``apply(params, feeds,
+outputs, train, rng)``, ``loss_vector``, ``param_specs`` (ordered, for the flat
+weight-list wire format), ``input_specs`` and a ``graphdef.resolve`` shim for
+tensor-name validation — so Trainer / predict_func / model_loader work on it
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Names:
+    """graphdef.resolve-compatible tensor-name table."""
+
+    def __init__(self, names: Sequence[str]):
+        self._names = {}
+        for i, n in enumerate(names):
+            self._names[n] = i
+            self._names[f"{n}:0"] = i
+
+    def resolve(self, tensor_name: str) -> int:
+        for cand in (tensor_name, f"{tensor_name}:0"):
+            if cand in self._names:
+                return self._names[cand]
+        known = ", ".join(sorted(k for k in self._names if not k.endswith(":0")))
+        raise KeyError(f"tensor {tensor_name!r} not found; known tensors: {known}")
+
+
+class RegistryModel:
+    """Base for registry models. Subclasses define:
+
+    - ``TENSORS``: output/input tensor names exposed to the estimator params
+    - ``input_specs()``, ``param_specs()`` (ordered), ``init(rng)``
+    - ``_forward(params, feeds, train, rng) -> dict of named tensors``
+    - ``_loss(params, feeds, train, rng) -> per-example loss vector``
+    """
+
+    TENSORS: Sequence[str] = ()
+
+    def __init__(self, compute_dtype: Optional[Any] = None):
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if isinstance(compute_dtype, str) else compute_dtype)
+        self.graphdef = _Names(self.TENSORS)
+
+    # -- GraphModel-compatible surface ---------------------------------------
+
+    def apply(self, params, feeds: Dict[str, Any], outputs: Sequence[str],
+              train: bool = False, rng=None) -> Dict[str, Any]:
+        feeds = {k.split(":")[0]: v for k, v in feeds.items()}
+        vals = self._forward(params, feeds, train, rng)
+        out = {}
+        for o in outputs:
+            key = o.split(":")[0] if isinstance(o, str) else o
+            if key not in vals:
+                raise KeyError(f"tensor {o!r} not produced; have {sorted(vals)}")
+            out[o] = vals[key]
+        return out
+
+    def loss_vector(self, params, feeds: Dict[str, Any], train: bool = True,
+                    rng=None):
+        feeds = {k.split(":")[0]: v for k, v in feeds.items()}
+        return self._loss(params, feeds, train, rng)
+
+    def init(self, rng):
+        params = {}
+        for lname, pspec in self.param_specs().items():
+            layer = {}
+            for pname, (shape, init_name) in pspec.items():
+                rng, sub = jax.random.split(rng)
+                layer[pname] = _initializer(init_name)(sub, shape, jnp.float32)
+            params[lname] = layer
+        return params
+
+    # -- helpers --------------------------------------------------------------
+
+    def cast(self, x):
+        if self.compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
+
+
+def _initializer(name: str):
+    from ..graphdef import _get_initializer
+    return _get_initializer(name)

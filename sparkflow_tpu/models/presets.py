@@ -1,0 +1,65 @@
+"""Graph-DSL preset builders for the reference's three example architectures.
+
+These return ``build_graph`` JSON, so they flow through the Estimator exactly
+like hand-written model functions (reference ``examples/*.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import nn
+from ..graph_utils import build_graph
+
+
+def mlp(input_dim: int, num_classes: int, hidden: Sequence[int] = (256, 256),
+        activation: str = "relu") -> str:
+    """The simple_dnn.py MLP shape (reference examples/simple_dnn.py:13-22)."""
+
+    def model():
+        x = nn.placeholder([None, input_dim], name="x")
+        y = nn.placeholder([None, num_classes], name="y")
+        h = x
+        for units in hidden:
+            h = nn.dense(h, units, activation=activation)
+        out = nn.dense(h, num_classes, name="out")
+        nn.argmax(out, 1, name="pred")
+        nn.softmax_cross_entropy(y, out)
+
+    return build_graph(model)
+
+
+def cnn(side: int = 28, channels: int = 1, num_classes: int = 10) -> str:
+    """The cnn_example.py conv net (reference examples/cnn_example.py:10-22)."""
+
+    def model():
+        x = nn.placeholder([None, side * side * channels], name="x")
+        y = nn.placeholder([None, num_classes], name="y")
+        xr = nn.reshape(x, [-1, side, side, channels])
+        c1 = nn.conv2d(xr, 32, 5, activation="relu")
+        p1 = nn.max_pooling2d(c1, 2, 2)
+        c2 = nn.conv2d(p1, 64, 3, activation="relu")
+        p2 = nn.max_pooling2d(c2, 2, 2)
+        out = nn.dense(nn.flatten(p2), num_classes, name="out")
+        nn.argmax(out, 1, name="pred")
+        nn.softmax_cross_entropy(y, out)
+
+    return build_graph(model)
+
+
+def autoencoder(input_dim: int = 784,
+                widths: Sequence[int] = (256, 128, 256)) -> str:
+    """The autoencoder_example.py stack; bottleneck exposed as 'out/Sigmoid:0'
+    (reference examples/autoencoder_example.py:9-16)."""
+    mid = len(widths) // 2
+
+    def model():
+        x = nn.placeholder([None, input_dim], name="x")
+        h = x
+        for i, w in enumerate(widths):
+            name = "out" if i == mid else None
+            act = "sigmoid" if i == mid else "relu"
+            h = nn.dense(h, w, activation=act, name=name)
+        recon = nn.dense(h, input_dim, activation="sigmoid")
+        nn.mean_squared_error(recon, x)
+
+    return build_graph(model)

@@ -1,0 +1,42 @@
+"""Model registry + JSON dispatch."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+REGISTRY_FORMAT = "sparkflow-tpu-model"
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.model_name = name
+        return cls
+    return deco
+
+
+def build_registry_spec(name: str, **config) -> str:
+    """JSON spec for a registry model — usable as the Estimator's
+    ``tensorflowGraph`` Param, like ``build_graph`` output."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return json.dumps({"format": REGISTRY_FORMAT, "version": 1,
+                       "model": name, "config": config})
+
+
+def model_from_json(spec: str, compute_dtype: Optional[Any] = None):
+    """Dispatch a JSON model spec to its executable model object."""
+    d = json.loads(spec)
+    fmt = d.get("format")
+    if fmt == REGISTRY_FORMAT:
+        cls = _REGISTRY.get(d["model"])
+        if cls is None:
+            raise KeyError(f"unknown registry model {d['model']!r}; "
+                           f"known: {sorted(_REGISTRY)}")
+        return cls(compute_dtype=compute_dtype, **d["config"])
+    # default: graph-DSL spec
+    from ..graphdef import GraphModel
+    return GraphModel.from_json(spec, compute_dtype)

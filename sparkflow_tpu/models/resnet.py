@@ -1,0 +1,172 @@
+"""ResNet family (v1.5 bottleneck) — functional JAX, stateless normalization.
+
+Covers BASELINE.md's "ResNet-50 / CIFAR-10" config:
+``build_registry_spec('resnet50', num_classes=10, image_size=32)``.
+
+Design notes (TPU-first):
+- GroupNorm instead of BatchNorm: batch statistics create cross-device state
+  and train/eval divergence; group norm is stateless, pure, and shards cleanly
+  over the batch axis (params stay tiny). This is a deliberate deviation — the
+  reference has no ResNet at all (new capability, SURVEY.md §6).
+- NHWC layout with f32 accumulation conv (bf16 operands under compute_dtype).
+- Standard stage layout [3,4,6,3] for ResNet-50; [2,2,2,2] basic blocks for
+  ResNet-18 via ``depth=18``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .base import RegistryModel
+from .registry import register_model
+
+_STAGES = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+           50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True)}
+
+
+def _conv(x, kernel, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _group_norm(x, scale, bias, groups=32, eps=1e-5):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2, 4), keepdims=True)
+    y = ((x32 - mean) * jax.lax.rsqrt(var + eps)).reshape(b, h, w, c)
+    return (y * scale + bias).astype(x.dtype)
+
+
+@register_model("resnet")
+class ResNet(RegistryModel):
+    TENSORS = ("x", "y", "logits", "probs", "pred")
+
+    def __init__(self, num_classes: int, depth: int = 50, image_size: int = 32,
+                 channels: int = 3, width: int = 64, compute_dtype=None):
+        if depth not in _STAGES:
+            raise ValueError(f"depth must be one of {sorted(_STAGES)}")
+        self.num_classes = num_classes
+        self.depth = depth
+        self.image_size = image_size
+        self.channels = channels
+        self.width = width
+        self.stages, self.bottleneck = _STAGES[depth]
+        super().__init__(compute_dtype)
+
+    # -- specs ----------------------------------------------------------------
+
+    def input_specs(self):
+        n = self.image_size
+        return {"x": ((None, n, n, self.channels), "float32"),
+                "y": ((None, self.num_classes), "float32")}
+
+    def _block_channels(self) -> List[Tuple[str, int, int, int]]:
+        """(name, cin, cmid, stride) per block, stage by stage."""
+        blocks = []
+        expansion = 4 if self.bottleneck else 1
+        cin = self.width
+        for si, n_blocks in enumerate(self.stages):
+            cmid = self.width * (2 ** si)
+            for bi in range(n_blocks):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                blocks.append((f"stage{si}_block{bi}", cin, cmid, stride))
+                cin = cmid * expansion
+        return blocks
+
+    def param_specs(self):
+        k = 3 if self.image_size <= 64 else 7  # CIFAR stem vs ImageNet stem
+        specs = {"stem": {"kernel": ((k, k, self.channels, self.width), "he_normal"),
+                          "gn_scale": ((self.width,), "ones"),
+                          "gn_bias": ((self.width,), "zeros")}}
+        expansion = 4 if self.bottleneck else 1
+        for name, cin, cmid, stride in self._block_channels():
+            cout = cmid * expansion
+            if self.bottleneck:
+                layer = {
+                    "conv1": ((1, 1, cin, cmid), "he_normal"),
+                    "gn1_scale": ((cmid,), "ones"), "gn1_bias": ((cmid,), "zeros"),
+                    "conv2": ((3, 3, cmid, cmid), "he_normal"),
+                    "gn2_scale": ((cmid,), "ones"), "gn2_bias": ((cmid,), "zeros"),
+                    "conv3": ((1, 1, cmid, cout), "he_normal"),
+                    "gn3_scale": ((cout,), "ones"), "gn3_bias": ((cout,), "zeros"),
+                }
+            else:
+                layer = {
+                    "conv1": ((3, 3, cin, cmid), "he_normal"),
+                    "gn1_scale": ((cmid,), "ones"), "gn1_bias": ((cmid,), "zeros"),
+                    "conv2": ((3, 3, cmid, cout), "he_normal"),
+                    "gn2_scale": ((cout,), "ones"), "gn2_bias": ((cout,), "zeros"),
+                }
+            if stride != 1 or cin != cout:
+                layer["proj"] = ((1, 1, cin, cout), "he_normal")
+                layer["gnp_scale"] = ((cout,), "ones")
+                layer["gnp_bias"] = ((cout,), "zeros")
+            specs[name] = layer
+        cfinal = self.width * (2 ** (len(self.stages) - 1)) * expansion
+        specs["head"] = {"kernel": ((cfinal, self.num_classes), "zeros"),
+                         "bias": ((self.num_classes,), "zeros")}
+        return specs
+
+    def param_pspecs(self):
+        """ResNets replicate cleanly (small params); DP/FSDP shard via optimizer
+        state if needed. All-replicated specs keep jit happy on any mesh."""
+        return jax.tree.map(lambda _: P(), self.param_specs(),
+                            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+                            and isinstance(x[1], str))
+
+    # -- forward ---------------------------------------------------------------
+
+    def _bottleneck_block(self, bp, x, stride):
+        y = jax.nn.relu(_group_norm(_conv(x, bp["conv1"]), bp["gn1_scale"], bp["gn1_bias"]))
+        y = jax.nn.relu(_group_norm(_conv(y, bp["conv2"], stride), bp["gn2_scale"], bp["gn2_bias"]))
+        y = _group_norm(_conv(y, bp["conv3"]), bp["gn3_scale"], bp["gn3_bias"])
+        if "proj" in bp:
+            x = _group_norm(_conv(x, bp["proj"], stride), bp["gnp_scale"], bp["gnp_bias"])
+        return jax.nn.relu(x + y)
+
+    def _basic_block(self, bp, x, stride):
+        y = jax.nn.relu(_group_norm(_conv(x, bp["conv1"], stride), bp["gn1_scale"], bp["gn1_bias"]))
+        y = _group_norm(_conv(y, bp["conv2"]), bp["gn2_scale"], bp["gn2_bias"])
+        if "proj" in bp:
+            x = _group_norm(_conv(x, bp["proj"], stride), bp["gnp_scale"], bp["gnp_bias"])
+        return jax.nn.relu(x + y)
+
+    def _forward(self, params, feeds, train, rng):
+        x = self.cast(feeds["x"])
+        sp = params["stem"]
+        stride = 1 if self.image_size <= 64 else 2
+        x = jax.nn.relu(_group_norm(_conv(x, sp["kernel"], stride),
+                                    sp["gn_scale"], sp["gn_bias"]))
+        if self.image_size > 64:
+            x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                      (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        block = self._bottleneck_block if self.bottleneck else self._basic_block
+        for name, _cin, _cmid, stride in self._block_channels():
+            x = block(params[name], x, stride)
+        pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        logits = jnp.matmul(pooled, params["head"]["kernel"]) + params["head"]["bias"]
+        return {"logits": logits,
+                "probs": jax.nn.softmax(logits, axis=-1),
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        y = feeds["y"].astype(jnp.float32)
+        return -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+
+
+@register_model("resnet50")
+class ResNet50(ResNet):
+    def __init__(self, num_classes: int, image_size: int = 32, channels: int = 3,
+                 compute_dtype=None):
+        super().__init__(num_classes, depth=50, image_size=image_size,
+                         channels=channels, compute_dtype=compute_dtype)

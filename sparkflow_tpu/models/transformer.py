@@ -1,0 +1,256 @@
+"""Transformer encoder/decoder models (BERT-class) — the flagship family.
+
+Hand-written functional JAX (no flax dependency) designed for the TPU:
+
+- attention runs the pallas :func:`~sparkflow_tpu.ops.flash_attention` kernel
+  (padding masks switch to the masked reference path), or
+  :func:`~sparkflow_tpu.ops.ring_attention` over an ``sp`` mesh axis when
+  sequence parallelism is enabled — long context is first-class;
+- matmuls keep operands in the compute dtype (bf16 on TPU) with f32
+  accumulation, layer norms and softmax statistics in f32;
+- :meth:`param_pspecs` gives megatron-style tensor-parallel PartitionSpecs
+  (qkv/fc1 column-sharded, o/fc2 row-sharded over ``tp``) so a ``jit`` over a
+  mesh shards the model with XLA inserting the collectives;
+- ``remat`` option wraps each block in ``jax.checkpoint`` to trade FLOPs for
+  HBM on long sequences.
+
+BASELINE.md's BERT-base seq-512 classification config is
+``build_registry_spec('transformer_classifier', vocab_size=30522, hidden=768,
+num_layers=12, num_heads=12, mlp_dim=3072, max_len=512, num_classes=N)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import attention_reference, flash_attention, ring_attention
+from .base import RegistryModel
+from .registry import register_model
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return y.astype(x.dtype)
+
+
+def _dense(x, kernel, bias=None):
+    y = jnp.matmul(x, kernel.astype(x.dtype))
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+class _TransformerBase(RegistryModel):
+    def __init__(self, vocab_size: int, hidden: int = 768, num_layers: int = 12,
+                 num_heads: int = 12, mlp_dim: int = 3072, max_len: int = 512,
+                 dropout: float = 0.1, remat: bool = False,
+                 sp_axis: Optional[str] = None, compute_dtype=None):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = hidden // num_heads
+        self.mlp_dim = mlp_dim
+        self.max_len = max_len
+        self.dropout = dropout
+        self.remat = remat
+        self.sp_axis = sp_axis  # set to the mesh axis name for ring attention
+        super().__init__(compute_dtype)
+
+    # -- specs ---------------------------------------------------------------
+
+    def input_specs(self):
+        return {"input_ids": ((None, self.max_len), "int32"),
+                "attention_mask": ((None, self.max_len), "float32")}
+
+    def _block_specs(self):
+        h, m = self.hidden, self.mlp_dim
+        return {
+            "ln1_scale": ((h,), "ones"), "ln1_bias": ((h,), "zeros"),
+            "qkv_kernel": ((h, 3 * h), "normal(0.02)"), "qkv_bias": ((3 * h,), "zeros"),
+            "o_kernel": ((h, h), "normal(0.02)"), "o_bias": ((h,), "zeros"),
+            "ln2_scale": ((h,), "ones"), "ln2_bias": ((h,), "zeros"),
+            "fc1_kernel": ((h, m), "normal(0.02)"), "fc1_bias": ((m,), "zeros"),
+            "fc2_kernel": ((m, h), "normal(0.02)"), "fc2_bias": ((h,), "zeros"),
+        }
+
+    def param_specs(self):
+        h = self.hidden
+        specs = {"embed": {"tok": ((self.vocab_size, h), "normal(0.02)"),
+                           "pos": ((self.max_len, h), "normal(0.02)")}}
+        for i in range(self.num_layers):
+            specs[f"block_{i}"] = self._block_specs()
+        specs["final_ln"] = {"scale": ((h,), "ones"), "bias": ((h,), "zeros")}
+        return specs
+
+    def _block_pspecs(self):
+        return {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_kernel": P(None, "tp"), "qkv_bias": P("tp"),
+            "o_kernel": P("tp", None), "o_bias": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "fc1_kernel": P(None, "tp"), "fc1_bias": P("tp"),
+            "fc2_kernel": P("tp", None), "fc2_bias": P(),
+        }
+
+    def param_pspecs(self):
+        """Megatron-style TP sharding rules, same tree structure as params."""
+        specs = {"embed": {"tok": P(None, None), "pos": P(None, None)}}
+        for i in range(self.num_layers):
+            specs[f"block_{i}"] = self._block_pspecs()
+        specs["final_ln"] = {"scale": P(), "bias": P()}
+        return specs
+
+    # -- forward -------------------------------------------------------------
+
+    def _dropout(self, x, train, rng):
+        if not train or self.dropout <= 0.0:
+            return x, rng
+        rng, sub = jax.random.split(rng)
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(sub, keep, x.shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype), rng
+
+    def _attention(self, q, k, v, mask, causal: bool):
+        """[B,S,H*D] qkv already split to [B,heads,S,D]."""
+        if self.sp_axis is not None:
+            return ring_attention(q, k, v, self.sp_axis, causal=causal,
+                                  kv_mask=mask)
+        if mask is not None:
+            # additive key mask -> masked reference path (flash kernel grows a
+            # mask argument in a later round)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s / math.sqrt(self.head_dim)
+            s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+            if causal:
+                qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+                ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+                s = jnp.where(qi >= ki, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+        return flash_attention(q, k, v, causal=causal)
+
+    def _block(self, bp, x, mask, causal, train, rng):
+        b, s, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = _dense(y, bp["qkv_kernel"], bp["qkv_bias"])
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
+        att = self._attention(q, k, v, mask, causal)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
+        att, rng = self._dropout(_dense(att, bp["o_kernel"], bp["o_bias"]), train, rng)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y = jax.nn.gelu(_dense(y, bp["fc1_kernel"], bp["fc1_bias"]))
+        y, rng = self._dropout(_dense(y, bp["fc2_kernel"], bp["fc2_bias"]), train, rng)
+        return x + y, rng
+
+    def _encode(self, params, feeds, causal, train, rng):
+        ids = feeds["input_ids"].astype(jnp.int32)
+        mask = feeds.get("attention_mask")
+        b, s = ids.shape
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        if self.sp_axis is not None:
+            # inside shard_map each device holds a sequence SHARD: use global
+            # positions, not local 0..s-1
+            offset = jax.lax.axis_index(self.sp_axis) * s
+            pos = jax.lax.dynamic_slice(params["embed"]["pos"], (offset, 0),
+                                        (s, self.hidden))
+        else:
+            pos = params["embed"]["pos"][:s]
+        x = x + pos[None, :, :]
+        x = self.cast(x)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        block = self._block
+        if self.remat:
+            block = jax.checkpoint(self._block, static_argnums=(3, 4))
+        for i in range(self.num_layers):
+            x, rng = block(params[f"block_{i}"], x, mask, causal, train, rng)
+        return _layer_norm(x, params["final_ln"]["scale"],
+                           params["final_ln"]["bias"]), mask
+
+
+@register_model("transformer_classifier")
+class TransformerClassifier(_TransformerBase):
+    """BERT-class encoder + mean-pool classification head."""
+
+    def __init__(self, vocab_size: int, num_classes: int, **kw):
+        self.num_classes = num_classes
+        super().__init__(vocab_size, **kw)
+        self.TENSORS = ("input_ids", "attention_mask", "y", "logits", "probs", "pred")
+        from .base import _Names
+        self.graphdef = _Names(self.TENSORS)
+
+    def input_specs(self):
+        specs = super().input_specs()
+        specs["y"] = ((None, self.num_classes), "float32")
+        return specs
+
+    def param_specs(self):
+        specs = super().param_specs()
+        specs["head"] = {"kernel": ((self.hidden, self.num_classes), "normal(0.02)"),
+                         "bias": ((self.num_classes,), "zeros")}
+        return specs
+
+    def param_pspecs(self):
+        specs = super().param_pspecs()
+        specs["head"] = {"kernel": P(None, None), "bias": P()}
+        return specs
+
+    def _forward(self, params, feeds, train, rng):
+        x, mask = self._encode(params, feeds, causal=False, train=train, rng=rng)
+        if mask is not None:
+            w = mask[:, :, None].astype(x.dtype)
+            pooled = jnp.sum(x * w, axis=1) / jnp.maximum(jnp.sum(w, axis=1), 1e-6)
+        else:
+            pooled = jnp.mean(x, axis=1)
+        logits = _dense(pooled.astype(jnp.float32), params["head"]["kernel"],
+                        params["head"]["bias"])
+        return {"logits": logits,
+                "probs": jax.nn.softmax(logits, axis=-1),
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        y = feeds["y"].astype(jnp.float32)
+        return -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+
+
+@register_model("transformer_lm")
+class TransformerLM(_TransformerBase):
+    """Causal decoder LM (next-token prediction); the long-context workhorse —
+    with ``sp_axis`` set its attention runs as ring attention over the mesh."""
+
+    def __init__(self, vocab_size: int, **kw):
+        super().__init__(vocab_size, **kw)
+        self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
+        from .base import _Names
+        self.graphdef = _Names(self.TENSORS)
+
+    def _forward(self, params, feeds, train, rng):
+        x, _ = self._encode(params, feeds, causal=True, train=train, rng=rng)
+        logits = jnp.matmul(x.astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return {"logits": logits,
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        ids = feeds["input_ids"].astype(jnp.int32)
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = ids[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        if "attention_mask" in feeds and feeds["attention_mask"] is not None:
+            w = feeds["attention_mask"][:, 1:].astype(jnp.float32)
+            return jnp.sum(nll * w, axis=-1) / jnp.maximum(jnp.sum(w, axis=-1), 1e-6)
+        return jnp.mean(nll, axis=-1)

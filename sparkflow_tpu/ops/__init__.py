@@ -1,0 +1,16 @@
+"""Custom TPU kernels (pallas) and their portable fallbacks.
+
+The reference delegates all kernels to the TF 1.x C++ runtime (SURVEY.md §2.2);
+here XLA compiles almost everything, and the hot ops that benefit from manual
+scheduling are hand-written pallas kernels with jnp fallbacks for CPU tests:
+
+- :func:`flash_attention` — fused online-softmax attention (no S x S
+  materialization in HBM)
+- :func:`ring_attention`  — sequence-parallel attention over an ``sp`` mesh
+  axis: K/V shards rotate around the ICI ring while softmax statistics merge
+  blockwise, giving O(S/n) memory per device for arbitrarily long sequences
+"""
+
+from .attention import flash_attention, ring_attention, attention_reference
+
+__all__ = ["flash_attention", "ring_attention", "attention_reference"]

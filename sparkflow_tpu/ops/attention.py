@@ -1,0 +1,238 @@
+"""Attention kernels: pallas flash attention + ring attention (sequence parallel).
+
+Nothing like this exists in the reference (it has no attention or sequence code
+at all — SURVEY.md §5 "Long-context"); these ops are the long-context foundation
+of the framework's transformer models.
+
+Layout convention: ``[batch, heads, seq, head_dim]``.
+
+- :func:`flash_attention`: single-device fused attention. The pallas kernel
+  tiles Q into ``block_q`` rows and streams K/V in ``block_k`` columns with the
+  online-softmax recurrence, so the S x S score matrix never hits HBM; scores
+  accumulate in f32 on the MXU regardless of input dtype. Falls back to a pure
+  jnp implementation off-TPU (CPU tests) and for tiny shapes where tiling
+  constraints don't hold.
+
+- :func:`ring_attention`: attention over a sequence-sharded mesh axis (``sp``).
+  Each device holds S/n of Q/K/V; K/V shards rotate around the ring via
+  ``ppermute`` (ICI neighbor exchange) for n steps while each device folds the
+  visiting block into its running (max, sum, acc) softmax state. Communication
+  overlaps compute and per-device memory stays O(S/n) — the standard TPU
+  long-context recipe (Liu et al., Ring Attention; jax-ml scaling-book §sharding).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent in some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Reference (jnp) implementation — ground truth for tests + CPU fallback
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        q_offset: int = 0, k_offset: int = 0):
+    """Plain softmax attention, f32 accumulation. Shapes [B,H,S,D]."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0) + q_offset
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1) + k_offset
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (TPU)
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0]                               # [block_q, d] input dtype
+        k = k_ref[0]                               # [block_k, d]
+        v = v_ref[0]                               # [block_k, d]
+        # native-dtype operands on the MXU, f32 accumulation
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:]                          # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [block_q, block_k] f32
+        alpha = jnp.exp(m_prev - m_new)            # [block_q, 1]
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    if causal:
+        # blocks entirely above the diagonal contribute nothing — skip them
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention; [B,H,S,D] -> [B,H,S,D].
+
+    Uses the pallas kernel on TPU when the sequence tiles cleanly; otherwise
+    (CPU tests, odd shapes) the jnp reference path — numerics match to fp
+    tolerance either way.
+    """
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
+    # (lanes); sequences must tile exactly (pad upstream otherwise)
+    tiles_ok = (pltpu is not None
+                and s % block_q == 0 and sk % block_k == 0
+                and block_q % 8 == 0 and block_k % 128 == 0 and d % 8 == 0)
+    if not tiles_ok:
+        return attention_reference(q, k, v, causal, scale)
+
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_kernel, sm_scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+
+def _block_stats(q, k, v, scale, causal, q_offset, k_offset, kv_mask=None):
+    """One blockwise attention step -> (acc, m, l) in f32. [B,H,Sq,D]x[B,H,Sk,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0) + q_offset
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1) + k_offset
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    if kv_mask is not None:  # [B, Sk] key padding mask
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                        # [B,H,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None, kv_mask=None):
+    """Attention where q/k/v are sequence-sharded over ``axis_name``.
+
+    Must run inside ``shard_map`` (or pjit-of-shard_map) with q/k/v carrying
+    the local sequence shard ``[B,H,S_local,D]``. K/V (and the optional
+    ``kv_mask`` [B,S_local] key-padding mask) rotate around the ring;
+    online-softmax stats merge per visit. Returns the local output shard.
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    q_offset = idx * s_local
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    have_mask = kv_mask is not None
+
+    def body(step, carry):
+        acc, m, l, kc, vc, mc = carry
+        # the k/v block currently resident came from device (idx - step) % n
+        src = (idx - step) % n
+        k_offset = src * s_local
+        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, q_offset, k_offset,
+                                  mc if have_mask else None)
+        m_new = jnp.maximum(m, m2)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m2 - m_new)
+        acc = acc * alpha + a2 * beta
+        l = l * alpha + l2 * beta
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        if have_mask:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        return acc, m_new, l, kc, vc, mc
+
+    b, h, sl, _ = q.shape
+    init = (jnp.zeros((b, h, sl, d), jnp.float32),
+            jnp.full((b, h, sl, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sl, 1), jnp.float32),
+            k, v,
+            kv_mask if have_mask else jnp.zeros((b, sl), jnp.float32))
+    acc, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, init)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)

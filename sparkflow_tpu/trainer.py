@@ -88,12 +88,13 @@ class Trainer:
                  mesh=None,
                  seed: int = 0,
                  compute_dtype=None):
-        if isinstance(graph, GraphModel):
-            self.model = graph
-        elif isinstance(graph, GraphDef):
+        if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
-        else:
-            self.model = GraphModel.from_json(graph, compute_dtype)
+        elif isinstance(graph, str):
+            from .models import model_from_json
+            self.model = model_from_json(graph, compute_dtype)
+        else:  # an executable model object (GraphModel or registry model)
+            self.model = graph
         # fail fast on bad tensor names (otherwise they surface later as a
         # confusing "placeholder not fed" error from the executor)
         self.model.graphdef.resolve(input_name)
