@@ -21,9 +21,10 @@ ImageNet residual network, stateless norm).
 from .registry import model_from_json, register_model, build_registry_spec
 from . import presets
 from .transformer import TransformerClassifier, TransformerLM
+from .moe import MoETransformerLM
 from .resnet import ResNet
 
 __all__ = [
     "model_from_json", "register_model", "build_registry_spec", "presets",
-    "TransformerClassifier", "TransformerLM", "ResNet",
+    "TransformerClassifier", "TransformerLM", "MoETransformerLM", "ResNet",
 ]

@@ -1,0 +1,166 @@
+"""Mixture-of-Experts transformer with expert parallelism over ``ep``.
+
+Switch-style top-1 routing with a load-balancing auxiliary loss (Fedus et al.,
+Switch Transformer; retrieved PAPERS.md pattern). Experts live stacked on a
+leading axis sharded over the ``ep`` mesh axis (``param_pspecs``), so with
+E == ep-size each device stores and computes exactly one expert's FFN over the
+token stream and GSPMD inserts the combine reduction over ICI — expert
+parallelism without manual all_to_all. Token-level hard capacity (dropping) is
+a later scheduling optimization; routing, gating, auxiliary loss, and the EP
+sharding are the real thing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .base import _Names
+from .registry import register_model
+from .transformer import _TransformerBase, _dense, _layer_norm
+
+
+class _MoEMixin:
+    """Replaces the dense FFN with a routed expert bank on MoE layers."""
+
+    def _init_moe(self, num_experts: int, moe_every: int, aux_weight: float):
+        self.num_experts = num_experts
+        self.moe_every = max(1, moe_every)
+        self.aux_weight = aux_weight
+        self._aux_losses = []
+
+    def _is_moe_layer(self, i: int) -> bool:
+        return (i % self.moe_every) == (self.moe_every - 1)
+
+    def _moe_block_specs(self):
+        h, m, e = self.hidden, self.mlp_dim, self.num_experts
+        specs = super()._block_specs()
+        for k in ("fc1_kernel", "fc1_bias", "fc2_kernel", "fc2_bias"):
+            del specs[k]
+        specs.update({
+            "router": ((h, e), "normal(0.02)"),
+            "experts_fc1": ((e, h, m), "normal(0.02)"),
+            "experts_b1": ((e, m), "zeros"),
+            "experts_fc2": ((e, m, h), "normal(0.02)"),
+            "experts_b2": ((e, h), "zeros"),
+        })
+        return specs
+
+    def _moe_block_pspecs(self):
+        specs = super()._block_pspecs()
+        for k in ("fc1_kernel", "fc1_bias", "fc2_kernel", "fc2_bias"):
+            del specs[k]
+        specs.update({
+            "router": P(),
+            "experts_fc1": P("ep", None, None),
+            "experts_b1": P("ep", None),
+            "experts_fc2": P("ep", None, None),
+            "experts_b2": P("ep", None),
+        })
+        return specs
+
+    def param_specs(self):
+        specs = super().param_specs()
+        for i in range(self.num_layers):
+            if self._is_moe_layer(i):
+                specs[f"block_{i}"] = self._moe_block_specs()
+        return specs
+
+    def param_pspecs(self):
+        specs = super().param_pspecs()
+        for i in range(self.num_layers):
+            if self._is_moe_layer(i):
+                specs[f"block_{i}"] = self._moe_block_pspecs()
+        return specs
+
+    def _moe_mlp(self, bp, x):
+        """x [B,S,H] -> routed expert FFN output + records the aux loss."""
+        b, s, h = x.shape
+        e = self.num_experts
+        router_logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32),
+                                   bp["router"])
+        probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E]
+        expert_idx = jnp.argmax(probs, axis=-1)                 # [B,S]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [B,S,1]
+
+        # Switch load-balancing loss: E * sum_e fraction_tokens_e * mean_prob_e
+        frac = jnp.mean(onehot, axis=(0, 1))                    # [E]
+        mean_prob = jnp.mean(probs, axis=(0, 1))                # [E]
+        self._aux_losses.append(e * jnp.sum(frac * mean_prob))
+
+        # expert bank, leading axis sharded over 'ep': each device computes its
+        # expert over the full token stream; the e-contraction below becomes a
+        # psum over ep under GSPMD. Non-selected contributions are zeroed by
+        # the one-hot combine.
+        xc = x
+        hmid = jnp.einsum("bsh,ehm->ebsm", xc, bp["experts_fc1"].astype(xc.dtype))
+        hmid = jax.nn.gelu(hmid + bp["experts_b1"].astype(hmid.dtype)[:, None, None, :])
+        out = jnp.einsum("ebsm,emh->ebsh", hmid, bp["experts_fc2"].astype(hmid.dtype))
+        out = out + bp["experts_b2"].astype(out.dtype)[:, None, None, :]
+        combined = jnp.einsum("ebsh,bse->bsh", out,
+                              (onehot * gate).astype(out.dtype))
+        return combined
+
+    def _block(self, bp, x, mask, causal, train, rng):
+        if "router" not in bp:
+            return super()._block(bp, x, mask, causal, train, rng)
+        b, s, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = _dense(y, bp["qkv_kernel"], bp["qkv_bias"])
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
+        att = self._attention(q, k, v, mask, causal)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
+        att, rng = self._dropout(_dense(att, bp["o_kernel"], bp["o_bias"]), train, rng)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y = self._moe_mlp(bp, y)
+        y, rng = self._dropout(y, train, rng)
+        return x + y, rng
+
+    def _collect_aux(self) -> jnp.ndarray:
+        """Sum and clear aux losses recorded during the last forward."""
+        if not self._aux_losses:
+            return jnp.zeros(())
+        total = sum(self._aux_losses[1:], self._aux_losses[0])
+        self._aux_losses = []
+        return total * self.aux_weight
+
+
+@register_model("transformer_moe_lm")
+class MoETransformerLM(_MoEMixin, _TransformerBase):
+    """Causal MoE LM: Switch FFN every ``moe_every``-th block, EP shardable."""
+
+    def __init__(self, vocab_size: int, num_experts: int = 8, moe_every: int = 2,
+                 router_aux_weight: float = 0.01, **kw):
+        self._init_moe(num_experts, moe_every, router_aux_weight)
+        super().__init__(vocab_size, **kw)
+        self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
+        self.graphdef = _Names(self.TENSORS)
+
+    def _forward(self, params, feeds, train, rng):
+        self._aux_losses = []
+        x, _ = self._encode(params, feeds, causal=True, train=train, rng=rng)
+        logits = jnp.matmul(x.astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return {"logits": logits,
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        ids = feeds["input_ids"].astype(jnp.int32)
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        aux = self._collect_aux()
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = ids[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        if "attention_mask" in feeds and feeds["attention_mask"] is not None:
+            w = feeds["attention_mask"][:, 1:].astype(jnp.float32)
+            per = jnp.sum(nll * w, axis=-1) / jnp.maximum(jnp.sum(w, axis=-1), 1e-6)
+        else:
+            per = jnp.mean(nll, axis=-1)
+        # aux spread per-example so the masked-mean trainer stays correct
+        return per + aux

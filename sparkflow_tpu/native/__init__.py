@@ -1,0 +1,7 @@
+"""Native (C++) runtime components. See ``dataplane.cpp`` and
+:mod:`sparkflow_tpu.native.build` for the compile-on-first-use machinery;
+the Python binding lives in :mod:`sparkflow_tpu.utils.data`."""
+
+from .build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,84 @@
+"""Compile-on-first-use for the native dataplane.
+
+The shared library is built once per machine into the package directory (or
+``SPARKFLOW_TPU_CACHE`` if set) and reused; failure to build degrades to the
+pure-numpy fallbacks in :mod:`sparkflow_tpu.utils.data` — never a hard error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "dataplane.cpp")
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("SPARKFLOW_TPU_CACHE")
+    if not d:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_cache_dir(), f"libsfdata-{tag}.so")
+
+
+def load_library(verbose: bool = False) -> Optional[ctypes.CDLL]:
+    """Return the compiled dataplane library, building it if needed.
+    None when no C++ toolchain is available (callers must fall back)."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _lib_path()
+        if not os.path.exists(path):
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   _SRC, "-o", path]
+            try:
+                subprocess.run(cmd, check=True, capture_output=not verbose,
+                               timeout=120)
+            except Exception as e:  # toolchain missing/broken -> numpy fallback
+                if verbose:
+                    print(f"sparkflow_tpu: native build failed ({e}); "
+                          f"using numpy fallback", file=sys.stderr)
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        _configure(lib)
+        _LIB = lib
+        return _LIB
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+    lib.sfq_create.restype = ctypes.c_void_p
+    lib.sfq_create.argtypes = [i64, i64, i64, i64, ctypes.c_int, ctypes.c_uint64]
+    lib.sfq_push.restype = i64
+    lib.sfq_push.argtypes = [ctypes.c_void_p, f32p, f32p, i64]
+    lib.sfq_finish.restype = None
+    lib.sfq_finish.argtypes = [ctypes.c_void_p]
+    lib.sfq_pop.restype = i64
+    lib.sfq_pop.argtypes = [ctypes.c_void_p, f32p, f32p, f32p]
+    lib.sfq_destroy.restype = None
+    lib.sfq_destroy.argtypes = [ctypes.c_void_p]
+    lib.sf_csv_load.restype = f32p
+    lib.sf_csv_load.argtypes = [ctypes.c_char_p, ctypes.POINTER(i64),
+                                ctypes.POINTER(i64)]
+    lib.sf_free.restype = None
+    lib.sf_free.argtypes = [ctypes.c_void_p]

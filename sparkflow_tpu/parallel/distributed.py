@@ -1,0 +1,105 @@
+"""Multi-host bootstrap: the control-plane replacement for the reference's
+parameter-server topology.
+
+The reference wires N Spark executors to one driver-hosted Flask PS over HTTP
+(``sparkflow/HogwildSparkModel.py:145-166``; ``determine_master`` resolves the
+driver address from ``spark.driver.host``). On TPU pods the data plane is the
+ICI/DCN mesh — no server — and the only control-plane job is bringing every
+TPU-VM worker into one JAX process group. That is ``jax.distributed.initialize``;
+this module wraps it with the same address-resolution conveniences the
+reference had, plus helpers to build global meshes and feed per-host data
+shards.
+
+Typical pod usage (one process per TPU-VM host, e.g. launched by the Spark
+driver or any job scheduler):
+
+    from sparkflow_tpu.parallel import distributed as dist
+    dist.initialize()                      # env-driven on TPU pods
+    mesh = dist.global_mesh({"dp": -1})    # all chips across all hosts
+    # per-host input shards -> jax.make_array_from_process_local_data
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+
+_INITIALIZED = False
+
+
+def determine_master(port: int = 8476) -> str:
+    """Resolve a coordinator address like the reference resolved the PS host
+    (``HogwildSparkModel.py:145-154``): explicit env first, then hostname."""
+    addr = os.environ.get("SPARKFLOW_TPU_COORDINATOR")
+    if addr:
+        return addr if ":" in addr else f"{addr}:{port}"
+    return f"{socket.gethostbyname(socket.gethostname())}:{port}"
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the global JAX process group. On TPU pods all arguments are
+    discovered from the TPU metadata; elsewhere pass them (or set
+    SPARKFLOW_TPU_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    # IMPORTANT: nothing here may touch devices (jax.devices/process_count)
+    # before jax.distributed.initialize — backend init would permanently
+    # preclude forming the process group.
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    elif os.environ.get("SPARKFLOW_TPU_COORDINATOR"):
+        kwargs["coordinator_address"] = determine_master()
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    elif os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    elif os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    multi_host = len(hosts) > 1
+    if kwargs or multi_host:
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as e:
+            if "more than once" in str(e):
+                pass  # a prior component already formed the group
+            else:
+                # e.g. backends were initialized before initialize() — that is
+                # a real misconfiguration on a pod; surface it
+                raise
+    _INITIALIZED = True
+
+
+def global_mesh(axes: Dict[str, int]) -> Mesh:
+    """Mesh over every device of every process (axes sizes may use -1)."""
+    return make_mesh(axes, devices=jax.devices())
+
+
+def process_local_batch(global_batch: int) -> int:
+    """Rows this host should feed per global step."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} processes")
+    return global_batch // n
+
+
+def host_shard_to_global(local: np.ndarray, mesh: Mesh, axis: str = "dp"):
+    """Assemble per-host numpy shards into one global sharded jax.Array
+    (the pod-scale analog of staging a partition onto the device mesh)."""
+    sharding = NamedSharding(mesh, P(axis))
+    global_shape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, local, global_shape)

@@ -1,0 +1,167 @@
+"""Pipeline parallelism: transformer blocks sharded into stages over ``pp``.
+
+Each device on the ``pp`` mesh axis holds 1/P of the transformer blocks
+(stacked and sharded on a leading stage axis), so model memory scales down
+with pipeline depth. Activations travel stage-to-stage with ``ppermute`` over
+the ICI ring; microbatches bound activation memory and gradients accumulate
+across them. Differentiation flows through the collective (ppermute transposes
+to the reverse permute), so this is a complete train step, not a forward-only
+demo.
+
+Round-1 schedule note: stages execute sequentially per microbatch (a device
+idles while another stage computes — the classic bubble). The 1F1B/GPipe
+overlapped schedule is a scheduling optimization on top of this same layout;
+the memory distribution, collectives, and numerics are already the real thing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def split_stage_params(model, params, n_stages: int):
+    """Repack transformer params into the pipeline layout:
+
+    - ``stages``: every per-block leaf stacked to [n_stages, blocks_per_stage, ...]
+      (shard the leading axis over 'pp')
+    - ``shared``: embed / final_ln / head, replicated on every stage.
+    """
+    n_layers = model.num_layers
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} blocks not divisible by {n_stages} stages")
+    per = n_layers // n_stages
+    blocks = [params[f"block_{i}"] for i in range(n_layers)]
+    stage_trees = []
+    for s in range(n_stages):
+        group = blocks[s * per:(s + 1) * per]
+        stage_trees.append(jax.tree.map(lambda *xs: jnp.stack(xs), *group))
+    stages = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_trees)
+    # copy shared leaves: the pp train step donates its params, and aliasing
+    # the caller's arrays would delete them out from under the caller
+    shared = jax.tree.map(jnp.array,
+                          {k: v for k, v in params.items()
+                           if not k.startswith("block_")})
+    return {"stages": stages, "shared": shared}
+
+
+def merge_stage_params(model, pp_params):
+    """Inverse of :func:`split_stage_params` (e.g. for checkpoint export)."""
+    n_layers = model.num_layers
+    stages = pp_params["stages"]
+    flat_example = jax.tree.leaves(stages)[0]
+    n_stages, per = flat_example.shape[0], flat_example.shape[1]
+    assert n_stages * per == n_layers
+    out = dict(pp_params["shared"])
+    for i in range(n_layers):
+        s, b = divmod(i, per)
+        out[f"block_{i}"] = jax.tree.map(lambda x: x[s, b], stages)
+    return out
+
+
+def pp_pspecs(pp_params):
+    """PartitionSpecs: stage axis over 'pp', shared replicated."""
+    stages = jax.tree.map(lambda x: P("pp"), pp_params["stages"])
+    shared = jax.tree.map(lambda x: P(), pp_params["shared"])
+    return {"stages": stages, "shared": shared}
+
+
+def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
+                       pp_axis: str = "pp"):
+    """Pipeline-parallel train step for the transformer classifier.
+
+    Signature: ``step(pp_params, opt_state, ids, y, rng) ->
+    (pp_params, opt_state, loss)`` — ids [B, S] replicated across pp (batch is
+    the microbatch loop's dimension), params in :func:`split_stage_params`
+    layout sharded over 'pp'.
+    """
+    n_stages = mesh.shape[pp_axis]
+    per = model.num_layers // n_stages
+
+    def stage_apply(stage_blocks, x, rng):
+        """Apply this device's ``per`` blocks (stacked leading axis)."""
+
+        def body(carry, block):
+            x, rng = carry
+            x, rng = model._block(block, x, None, False, True, rng)
+            return (x, rng), None
+
+        (x, rng), _ = jax.lax.scan(body, (x, rng), stage_blocks)
+        return x
+
+    def forward_one(pp_params, ids, y, rng):
+        s = jax.lax.axis_index(pp_axis)
+        shared = pp_params["shared"]
+        my_blocks = jax.tree.map(lambda a: a[0], pp_params["stages"])
+
+        ids = ids.astype(jnp.int32)
+        b, seq = ids.shape
+        x = jnp.take(shared["embed"]["tok"], ids, axis=0)
+        x = x + shared["embed"]["pos"][:seq][None, :, :]
+        x = model.cast(x)
+
+        def tick(t, x):
+            def run(x):
+                return stage_apply(my_blocks, x, jax.random.fold_in(rng, t))
+            x = jax.lax.cond(s == t, run, lambda x: x, x)
+            return jax.lax.ppermute(
+                x, pp_axis, [(i, (i + 1) % n_stages) for i in range(n_stages)])
+
+        x = jax.lax.fori_loop(0, n_stages, tick, x)
+        # after n_stages ticks the fully-processed activation is back on stage 0
+        from ..models.transformer import _dense, _layer_norm
+        x = _layer_norm(x, shared["final_ln"]["scale"], shared["final_ln"]["bias"])
+        pooled = jnp.mean(x, axis=1).astype(jnp.float32)
+        logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
+        per_ex = -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        # only stage 0 holds the real result; zero others and sum over pp
+        loss = jnp.where(s == 0, jnp.mean(per_ex), 0.0)
+        return jax.lax.psum(loss, pp_axis)
+
+    param_specs = {"stages": P(pp_axis), "shared": P()}  # pytree prefixes
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_specs, P(), P(), P()),
+             out_specs=(param_specs, P()),
+             check_vma=False)
+    def grad_fn(pp_params, ids, y, rng):
+        if ids.shape[0] % n_microbatches or ids.shape[0] < n_microbatches:
+            raise ValueError(
+                f"batch {ids.shape[0]} must be a positive multiple of "
+                f"n_microbatches={n_microbatches}")
+        mb = ids.shape[0] // n_microbatches
+
+        def micro(i, carry):
+            grads_acc, loss_acc = carry
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * mb, mb, axis=0)
+            loss, g = jax.value_and_grad(forward_one)(
+                pp_params, sl(ids), sl(y), jax.random.fold_in(rng, i))
+            grads_acc = jax.tree.map(jnp.add, grads_acc, g)
+            return grads_acc, loss_acc + loss
+
+        zero = jax.tree.map(jnp.zeros_like, pp_params)
+        grads, loss = jax.lax.fori_loop(0, n_microbatches, micro,
+                                        (zero, jnp.zeros(())))
+        grads = jax.tree.map(lambda x: x / n_microbatches, grads)
+        # shared params got gradient contributions on every stage: reduce;
+        # stage params are exclusively local (their grads are already correct)
+        grads["shared"] = jax.tree.map(
+            lambda gg: jax.lax.psum(gg, pp_axis), grads["shared"])
+        return grads, loss / n_microbatches
+
+    def step(pp_params, opt_state, ids, y, rng):
+        grads, loss = grad_fn(pp_params, ids, y, rng)
+        # the optax update runs under GSPMD: sharded stage leaves update
+        # locally, replicated shared leaves update identically everywhere
+        updates, opt_state = optimizer.update(grads, opt_state, pp_params)
+        pp_params = optax.apply_updates(pp_params, updates)
+        return pp_params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -19,10 +19,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import _step_body, make_loss_fn
 
 
+def filter_pspec(spec: P, mesh: Mesh) -> P:
+    """Drop axes the mesh doesn't have (so e.g. megatron 'tp' rules place
+    cleanly on an {'ep'}-only or {'dp'}-only mesh as replicated)."""
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in mesh.axis_names)
+            return kept if kept else None
+        return a if a in mesh.axis_names else None
+
+    return P(*(keep(a) for a in spec))
+
+
 def shard_params(params, mesh: Mesh, pspecs):
-    """Place a params pytree onto the mesh per a PartitionSpec pytree."""
+    """Place a params pytree onto the mesh per a PartitionSpec pytree; spec
+    axes absent from the mesh degrade to replication."""
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, pspecs,
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, filter_pspec(s, mesh))),
+        params, pspecs,
         is_leaf=lambda x: not isinstance(x, dict))
 
 

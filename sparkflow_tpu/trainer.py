@@ -123,13 +123,14 @@ class Trainer:
 
     # -- batching plan ------------------------------------------------------
 
+    def _dp_size(self) -> int:
+        from .parallel.mesh import mesh_axis_size
+        return mesh_axis_size(self.mesh, "dp")
+
     def _plan(self, n: int):
         """Resolve (mode, batch_size, num_batches) from the reference's three
         batching modes (``sparkflow/HogwildSparkModel.py:62-92``)."""
-        dp = 1
-        if self.mesh is not None:
-            dp = int(np.prod([s for name, s in zip(self.mesh.axis_names, self.mesh.devices.shape)
-                              if name == "dp"])) or 1
+        dp = self._dp_size()
         bs = self.mini_batch_size
         stochastic = bool(self.mini_stochastic_iters and self.mini_stochastic_iters > 0)
         if bs is None or bs <= 0 or (bs >= n and not stochastic):
@@ -223,6 +224,81 @@ class Trainer:
         self.params = params
         epoch_losses = [float(l) for l in loss_handles]
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
+
+    def fit_stream(self, row_iterator, init_params=None, queue_capacity: int = 8,
+                   chunk: int = 1024) -> TrainResult:
+        """Streaming fit for datasets that don't fit in device memory.
+
+        ``row_iterator`` yields ``(features, label)`` pairs (bare features when
+        unsupervised). A native C++ batch-assembly thread (numpy fallback)
+        pads/masks/shuffles fixed-shape batches concurrently with device
+        compute; each batch is one synchronous optimizer step. ``iters`` and
+        ``partition_shuffles`` are single-pass here: epochs over a stream
+        require the caller to re-supply the iterator (matching Spark's
+        rdd.toLocalIterator semantics).
+        """
+        from .core import make_train_step
+        from .utils.data import BatchQueue, feed_from_iterator
+
+        supervised = self.label_name is not None
+        rng = jax.random.PRNGKey(self.seed)
+        init_rng, rng = jax.random.split(rng)
+
+        bs = self.mini_batch_size if self.mini_batch_size and self.mini_batch_size > 0 else 128
+        bs = -(-bs // self._dp_size()) * self._dp_size()
+
+        it = iter(row_iterator)
+        try:
+            first = next(it)
+        except StopIteration:
+            raise ValueError("no training data")
+        import itertools as _it
+        from .localml.linalg import vector_to_array
+        feat0 = vector_to_array(first[0] if supervised else first)
+        row_dim = int(feat0.shape[0])
+        if supervised:
+            lbl0 = first[1]
+            label_dim = 1 if isinstance(lbl0, (int, float)) else len(vector_to_array(lbl0))
+        else:
+            label_dim = 0
+
+        q = BatchQueue(bs, row_dim, label_dim, capacity=queue_capacity,
+                       shuffle=self.shuffle_per_iter, seed=self.seed)
+        feeder = feed_from_iterator(q, _it.chain([first], it), supervised, chunk)
+
+        if init_params is not None:
+            params = jax.tree.map(lambda a: jnp.array(a), init_params)
+        else:
+            params = self.model.init(init_rng)
+        opt_state = self.optimizer.init(params)
+        loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
+        step = make_train_step(loss_fn, self.optimizer, self.mesh)
+
+        losses = []
+        seen = 0
+        t0 = time.perf_counter()
+        dummy_y = np.zeros((bs, 1), np.float32)
+        try:
+            for x, y, mask, n_real in q:
+                rng, srng = jax.random.split(rng)
+                params, opt_state, loss = step(params, opt_state, x,
+                                               y if supervised else dummy_y,
+                                               mask, srng)
+                losses.append(loss)
+                seen += n_real
+                if self.loss_callback is not None:
+                    self.loss_callback(float(loss), len(losses), 0)
+            feeder.join()
+        finally:
+            # always tear the queue down (drains and unblocks the feeder);
+            # without this a failing step would leak the native ring and leave
+            # the producer thread blocked forever
+            q.close()
+        params = jax.block_until_ready(params)
+        wall = time.perf_counter() - t0
+        self.params = params
+        return TrainResult(params, [float(l) for l in losses],
+                           seen / max(wall, 1e-9), wall)
 
     # -- conveniences -------------------------------------------------------
 

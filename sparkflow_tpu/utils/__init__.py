@@ -1,0 +1,5 @@
+"""Utilities: data plane binding, profiling/tracing, structured metrics."""
+
+from . import data, metrics, tracing
+
+__all__ = ["data", "metrics", "tracing"]

@@ -1,0 +1,79 @@
+"""Structured training metrics (replaces the reference's print-based logging,
+``sparkflow/HogwildSparkModel.py:94-98`` — SURVEY.md §5 "observability").
+
+A process-local registry of counters/gauges/timings with JSONL export and an
+optional per-step callback fan-out. Cheap enough to leave on: recording is a
+dict update; device syncs only happen where the caller already has a value.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Metrics:
+    def __init__(self):
+        self._scalars: Dict[str, List[tuple]] = defaultdict(list)
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._listeners: List[Callable[[str, float, int], None]] = []
+
+    def scalar(self, name: str, value: float, step: Optional[int] = None) -> None:
+        step = step if step is not None else len(self._scalars[name])
+        self._scalars[name].append((step, float(value), time.time()))
+        for fn in self._listeners:
+            fn(name, float(value), step)
+
+    def incr(self, name: str, amount: float = 1.0) -> None:
+        self._counters[name] += amount
+
+    def subscribe(self, fn: Callable[[str, float, int], None]) -> None:
+        self._listeners.append(fn)
+
+    def series(self, name: str) -> List[tuple]:
+        return list(self._scalars.get(name, []))
+
+    def counters(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"counters": self.counters()}
+        for name, pts in self._scalars.items():
+            vals = [v for _, v, _ in pts]
+            out[name] = {"last": vals[-1], "min": min(vals), "max": max(vals),
+                         "count": len(vals)}
+        return out
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for name, pts in self._scalars.items():
+                for step, value, ts in pts:
+                    f.write(json.dumps({"name": name, "step": step,
+                                        "value": value, "ts": ts}) + "\n")
+            for name, value in self._counters.items():
+                f.write(json.dumps({"name": name, "counter": value}) + "\n")
+
+    def reset(self) -> None:
+        self._scalars.clear()
+        self._counters.clear()
+
+
+default_metrics = Metrics()
+
+
+class timer:
+    """``with timer('stage'):`` records wall seconds into the registry."""
+
+    def __init__(self, name: str, metrics: Optional[Metrics] = None):
+        self.name = name
+        self.metrics = metrics or default_metrics
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.scalar(f"time/{self.name}", time.perf_counter() - self._t0)
+        return False

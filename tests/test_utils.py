@@ -1,0 +1,116 @@
+"""Native dataplane (queue + CSV), streaming fit, metrics, tracing."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.trainer import Trainer
+from sparkflow_tpu.utils.data import BatchQueue, load_csv_matrix
+from sparkflow_tpu.utils.metrics import Metrics, timer
+from sparkflow_tpu.native.build import load_library
+
+
+def test_native_library_builds():
+    # the image ships g++; if this fails the numpy fallback still works but we
+    # want to know the native path regressed
+    assert load_library() is not None
+
+
+def test_csv_loader_roundtrip(tmp_path):
+    rs = np.random.RandomState(0)
+    m = rs.rand(50, 7).astype(np.float32)
+    p = str(tmp_path / "m.csv")
+    np.savetxt(p, m, delimiter=",", fmt="%.6f")
+    a = load_csv_matrix(p)
+    assert a.shape == (50, 7)
+    np.testing.assert_allclose(a, m, atol=1e-5)
+
+
+def test_batch_queue_preserves_rows_and_masks():
+    rs = np.random.RandomState(1)
+    M = rs.rand(250, 5).astype(np.float32)
+    Y = rs.rand(250, 2).astype(np.float32)
+    q = BatchQueue(batch_size=64, row_dim=5, label_dim=2, capacity=3,
+                   shuffle=True, seed=7)
+
+    def produce():
+        for i in range(0, 250, 90):
+            q.push(M[i:i + 90], Y[i:i + 90])
+        q.finish()
+
+    threading.Thread(target=produce, daemon=True).start()
+    rows, total = [], 0
+    for x, y, mask, n in q:
+        assert x.shape == (64, 5) and mask.sum() == n
+        assert np.all(x[n:] == 0)  # padding is zeroed
+        rows.append(x[:n])
+        total += n
+    q.close()
+    assert total == 250
+    got = np.concatenate(rows)
+    np.testing.assert_allclose(np.sort(got[:, 0]), np.sort(M[:, 0]), atol=1e-6)
+
+
+def test_batch_queue_unsupervised():
+    q = BatchQueue(batch_size=16, row_dim=3, label_dim=0, capacity=2,
+                   shuffle=False)
+    q.push(np.ones((10, 3), np.float32))
+    q.finish()
+    x, y, mask, n = q.pop()
+    assert n == 10 and y.shape[1] == 0
+    assert q.pop() is None
+    q.close()
+
+
+def test_fit_stream_learns():
+    rs = np.random.RandomState(0)
+    M = rs.randn(600, 12).astype(np.float32)
+    lbl = (M @ rs.randn(12) > 0).astype(np.float32)
+
+    def m():
+        x = nn.placeholder([None, 12], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        nn.sigmoid_cross_entropy(y, nn.dense(x, 1, name="out"))
+
+    tr = Trainer(build_graph(m), "x:0", "y:0", mini_batch_size=64,
+                 learning_rate=0.2)
+    res = tr.fit_stream(zip(list(M), list(lbl)))
+    assert res.losses[-1] < res.losses[0]
+    assert len(res.losses) == -(-600 // 64)
+
+
+def test_metrics_registry():
+    m = Metrics()
+    for i in range(5):
+        m.scalar("loss", 1.0 / (i + 1), step=i)
+    m.incr("steps", 5)
+    with timer("fake", m):
+        pass
+    s = m.summary()
+    assert s["loss"]["count"] == 5 and s["loss"]["last"] == 0.2
+    assert s["counters"]["steps"] == 5
+    assert "time/fake" in s
+
+
+def test_metrics_jsonl_dump(tmp_path):
+    m = Metrics()
+    m.scalar("a", 1.0)
+    p = str(tmp_path / "m.jsonl")
+    m.dump_jsonl(p)
+    import json
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[0]["name"] == "a"
+
+
+def test_tracing_annotate_runs():
+    import jax
+    import jax.numpy as jnp
+    from sparkflow_tpu.utils.tracing import annotate
+
+    with annotate("test-region"):
+        v = jax.jit(lambda x: x * 2)(jnp.ones(4))
+    assert float(v.sum()) == 8.0
