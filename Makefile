@@ -1,0 +1,32 @@
+# Developer entry points (role parity with the reference's Makefile:1-17,
+# which ran the examples and tests in Docker).
+
+.PHONY: test test-fast bench baseline examples native clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -x -q -k "not estimator"
+
+bench:
+	python bench.py
+
+bench-quick:
+	python bench.py --quick
+
+baseline:
+	python bench_baseline.py
+
+examples:
+	cd examples && SPARKFLOW_TPU_SMOKE=1 python simple_dnn.py && \
+	SPARKFLOW_TPU_SMOKE=1 python cnn_example.py && \
+	SPARKFLOW_TPU_SMOKE=1 python autoencoder_example.py
+
+native:
+	python -c "from sparkflow_tpu.native.build import load_library; \
+	           print('native lib:', load_library(verbose=True))"
+
+clean:
+	rm -rf sparkflow_tpu/native/_build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,63 @@
+"""Long-context causal LM with ring-attention sequence parallelism.
+
+Demonstrates the framework's long-context path: the sequence axis shards over
+an ``sp`` mesh ring, K/V blocks rotate over ICI, and per-device memory is
+O(S / n_devices) — contexts far beyond one chip's HBM train without code
+changes. Runs on the virtual CPU mesh for demonstration; the same code spans a
+real pod slice.
+"""
+
+import os
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if jax.device_count() < 4:  # demo needs a mesh; force the virtual one
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:
+            pass
+
+    import jax.numpy as jnp
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+    from sparkflow_tpu.optimizers import build_optimizer
+    from sparkflow_tpu.parallel.mesh import make_mesh
+    from sparkflow_tpu.parallel.sp import make_sp_train_step
+
+    smoke = bool(os.environ.get("SPARKFLOW_TPU_SMOKE"))
+    sp = 4
+    dp = max(1, jax.device_count() // sp)
+    seq = 512 if smoke else 8192          # global context length
+    spec = build_registry_spec(
+        "transformer_lm", vocab_size=512,
+        hidden=64 if smoke else 512,
+        num_layers=2 if smoke else 8,
+        num_heads=4 if smoke else 8,
+        mlp_dim=128 if smoke else 2048,
+        max_len=seq, dropout=0.0, remat=not smoke)
+
+    lm = model_from_json(spec)
+    mesh = make_mesh({"dp": dp, "sp": sp})
+    print(f"mesh: dp={dp} x sp={sp}, context length {seq}")
+
+    optimizer = build_optimizer("adam", 3e-4, None)
+    params = lm.init(jax.random.PRNGKey(0))
+    opt_state = optimizer.init(params)
+    step = make_sp_train_step(lm, optimizer, mesh)
+
+    rs = np.random.RandomState(0)
+    batch = 2 * dp
+    for i in range(3):
+        ids = jnp.asarray(rs.randint(0, 512, (batch, seq)), jnp.int32)
+        mask = jnp.ones((batch, seq), jnp.float32)
+        params, opt_state, loss = step(params, opt_state, ids, mask,
+                                       jax.random.PRNGKey(i))
+        print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,18 @@ def convert_json_to_weights(json_weights: str) -> List[np.ndarray]:
     return [np.asarray(x, dtype=np.float32) for x in json.loads(json_weights)]
 
 
+def resolve_weights(weights_str: str) -> List[np.ndarray]:
+    """Decode a model's weight Param: inline JSON (reference wire format) or a
+    side-file reference ``npz:<path>`` — the large-model escape hatch for the
+    whole-weights-inside-pipeline-metadata anti-feature (SURVEY.md
+    §anti-features; ``sparkflow/tensorflow_async.py:310``)."""
+    if weights_str.startswith("npz:"):
+        path = weights_str[4:]
+        with np.load(path) as z:
+            return [z[k] for k in sorted(z.files, key=lambda s: int(s.split("_")[-1]))]
+    return convert_json_to_weights(weights_str)
+
+
 def params_to_json(model: GraphModel, params) -> str:
     return convert_weights_to_json(params_to_list(model, params))
 
@@ -90,7 +102,7 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
     dropout_v = 1.0 if (tf_dropout is not None and to_keep_dropout) else 0.0
     model, fn = _cached_predict_fn(graph_json, activation, tf_input,
                                    tf_dropout, dropout_v)
-    params = json_to_params(model, graph_weights)
+    params = list_to_params(model, resolve_weights(graph_weights))
     x = np.stack([vector_to_array(rd[inp]) for rd in row_dicts]).astype(np.float32)
     preds = predict_in_chunks(fn, params, x, chunk_size)
     for rd, p in zip(row_dicts, preds):

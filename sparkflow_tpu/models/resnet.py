@@ -142,6 +142,9 @@ class ResNet(RegistryModel):
 
     def _forward(self, params, feeds, train, rng):
         x = self.cast(feeds["x"])
+        if x.ndim == 2:  # flattened Spark vector column -> NHWC
+            n = self.image_size
+            x = x.reshape(x.shape[0], n, n, self.channels)
         sp = params["stem"]
         stride = 1 if self.image_size <= 64 else 2
         x = jax.nn.relu(_group_norm(_conv(x, sp["kernel"], stride),

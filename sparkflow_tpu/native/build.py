@@ -73,6 +73,8 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.sfq_push.argtypes = [ctypes.c_void_p, f32p, f32p, i64]
     lib.sfq_finish.restype = None
     lib.sfq_finish.argtypes = [ctypes.c_void_p]
+    lib.sfq_close.restype = None
+    lib.sfq_close.argtypes = [ctypes.c_void_p]
     lib.sfq_pop.restype = i64
     lib.sfq_pop.argtypes = [ctypes.c_void_p, f32p, f32p, f32p]
     lib.sfq_destroy.restype = None

@@ -178,6 +178,17 @@ int64_t sfq_pop(SfQueue* q, float* x_out, float* y_out, float* mask_out) {
   return b.n_real;
 }
 
+// Mark closed and wake every blocked producer/consumer. Does NOT free — the
+// binding calls this first, waits for its own threads to return from the C
+// calls, then calls sfq_destroy. Safe to call repeatedly.
+void sfq_close(SfQueue* q) {
+  if (!q) return;
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->cv_push.notify_all();
+  q->cv_pop.notify_all();
+}
+
 void sfq_destroy(SfQueue* q) {
   if (!q) return;
   {

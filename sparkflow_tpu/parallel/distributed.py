@@ -70,16 +70,19 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
     hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     multi_host = len(hosts) > 1
-    if kwargs or multi_host:
-        try:
-            jax.distributed.initialize(**kwargs)
-        except RuntimeError as e:
-            if "more than once" in str(e):
-                pass  # a prior component already formed the group
-            else:
-                # e.g. backends were initialized before initialize() — that is
-                # a real misconfiguration on a pod; surface it
-                raise
+    if not (kwargs or multi_host):
+        # nothing to do (single host, no explicit coordination args) — do NOT
+        # latch, so a later call WITH explicit args still forms the group
+        return
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "more than once" in str(e):
+            pass  # a prior component already formed the group
+        else:
+            # e.g. backends were initialized before initialize() — that is
+            # a real misconfiguration on a pod; surface it
+            raise
     _INITIALIZED = True
 
 

@@ -130,6 +130,14 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     partitionShuffles = Param(Params._dummy(), "partitionShuffles", "", typeConverter=TypeConverters.toInt)
     optimizerOptions = Param(Params._dummy(), "optimizerOptions", "", typeConverter=TypeConverters.toString)
     port = Param(Params._dummy(), "port", "", typeConverter=TypeConverters.toInt)
+    # upgrades over the reference param set (defaults preserve its behavior):
+    # weightsPath: store trained weights in an npz side-file instead of inline
+    # JSON (the reference's whole-weights-in-pipeline-metadata becomes
+    # impractical for ResNet/BERT-scale models — SURVEY.md anti-features);
+    # checkpointDir/checkpointEvery: mid-training checkpoint + resume.
+    weightsPath = Param(Params._dummy(), "weightsPath", "", typeConverter=TypeConverters.toString)
+    checkpointDir = Param(Params._dummy(), "checkpointDir", "", typeConverter=TypeConverters.toString)
+    checkpointEvery = Param(Params._dummy(), "checkpointEvery", "", typeConverter=TypeConverters.toInt)
 
     @keyword_only
     def __init__(self,
@@ -153,10 +161,15 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  labelCol=None,
                  partitionShuffles=None,
                  optimizerOptions=None,
-                 port=None):
+                 port=None,
+                 weightsPath=None,
+                 checkpointDir=None,
+                 checkpointEvery=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
-        accepted no-ops under synchronous all-reduce training."""
+        accepted no-ops under synchronous all-reduce training. ``weightsPath``,
+        ``checkpointDir``/``checkpointEvery`` are upgrades (side-file weights,
+        mid-training checkpoint+resume)."""
         super(SparkAsyncDL, self).__init__()
         self._setDefault(inputCol='transformed', tensorflowGraph='',
                          tfInput='x:0', tfLabel=None, tfOutput='out/Sigmoid:0',
@@ -165,7 +178,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          shufflePerIter=True, tfDropout=None, acquireLock=False,
                          verbose=0, iters=1000, toKeepDropout=False,
                          predictionCol='predicted', labelCol=None,
-                         partitionShuffles=1, optimizerOptions=None, port=5000)
+                         partitionShuffles=1, optimizerOptions=None, port=5000,
+                         weightsPath=None, checkpointDir=None, checkpointEvery=0)
+        self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -191,9 +206,20 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   labelCol=None,
                   partitionShuffles=None,
                   optimizerOptions=None,
-                  port=None):
+                  port=None,
+                  weightsPath=None,
+                  checkpointDir=None,
+                  checkpointEvery=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
+
+    def setLossCallback(self, fn):
+        """Per-iteration ``fn(loss, iteration, partition_id)`` hook — the hook
+        the reference declared on HogwildSparkModel but never plumbed through
+        the estimator (``HogwildSparkModel.py:124``; SURVEY.md §5). Not a
+        Param (functions don't persist); set it per-fit."""
+        self._loss_callback = fn
+        return self
 
     # getters (reference tensorflow_async.py:212-264)
     def getTensorflowGraph(self):
@@ -281,12 +307,32 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             shuffle_per_iter=self.getShufflePerIter(),
             partition_shuffles=self.getPartitionShuffles(),
             verbose=self.getVerbose(),
+            loss_callback=self._loss_callback,
             dropout_name=self.getTfDropout(),
             acquire_lock=self.getAcquireLock(),
             mesh=default_mesh(),
+            checkpoint_dir=self.getOrDefault(self.checkpointDir),
+            checkpoint_every=self.getOrDefault(self.checkpointEvery) or 0,
         )
         result = trainer.fit(features, labels)
-        weights_json = convert_weights_to_json(trainer.weights_list())
+        weights_path = self.getOrDefault(self.weightsPath)
+        if weights_path:
+            if not weights_path.endswith(".npz"):
+                weights_path += ".npz"
+            np.savez(weights_path,
+                     **{f"w_{i}": w for i, w in enumerate(trainer.weights_list())})
+            # NOTE: the model stores this PATH, not the weights — unlike the
+            # reference's self-contained inline JSON, the file must be visible
+            # to every executor/machine that transforms or loads the pipeline
+            # (use a shared filesystem path).
+            import logging
+            logging.getLogger("sparkflow_tpu").warning(
+                "weightsPath=%s: model references a filesystem path; ensure it "
+                "is reachable from all executors and travels with saved "
+                "pipelines", weights_path)
+            weights_json = "npz:" + weights_path
+        else:
+            weights_json = convert_weights_to_json(trainer.weights_list())
 
         return SparkAsyncDLModel(
             inputCol=inp_col,

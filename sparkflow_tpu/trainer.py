@@ -87,7 +87,10 @@ class Trainer:
                  acquire_lock: bool = False,  # accepted for API parity; no-op
                  mesh=None,
                  seed: int = 0,
-                 compute_dtype=None):
+                 compute_dtype=None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 metrics=None):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -120,6 +123,14 @@ class Trainer:
         self.seed = seed
         self.params = None
         self._epoch_cache = {}  # (batch, num_batches, mode, shuffle) -> compiled epoch
+        # step-level checkpoint/resume — a capability upgrade over the
+        # reference's save-at-end-only persistence (SURVEY.md §5)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        if metrics is None:
+            from .utils.metrics import default_metrics
+            metrics = default_metrics
+        self.metrics = metrics
 
     # -- batching plan ------------------------------------------------------
 
@@ -184,6 +195,22 @@ class Trainer:
             params = self.model.init(init_rng)
         opt_state = self.optimizer.init(params)
 
+        ckpt_mgr = None
+        start_epoch = 0
+        if self.checkpoint_dir:
+            from .checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(self.checkpoint_dir)
+            state = ckpt_mgr.restore(like={"params": params,
+                                           "opt_state": opt_state,
+                                           "epoch": np.int64(0),
+                                           "rng": np.asarray(rng)})
+            if state is not None:
+                params = jax.tree.map(jnp.asarray, state["params"])
+                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                start_epoch = int(state["epoch"])
+                rng = jnp.asarray(state["rng"])
+                logger.info("resumed from checkpoint at epoch %d", start_epoch)
+
         cache_key = (batch, num_batches, mode, self.shuffle_per_iter)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
@@ -198,29 +225,41 @@ class Trainer:
         loss_handles = []  # device scalars; converted lazily to keep async dispatch
         t0 = time.perf_counter()
         it = 0
+        total_epochs = self.partition_shuffles * self.iters
         for _round in range(self.partition_shuffles):
             for _epoch in range(self.iters):
+                it += 1
+                if it <= start_epoch:
+                    # the restored rng was saved AFTER these epochs' splits —
+                    # skip without touching it so the stream continues exactly
+                    # where the interrupted run left off
+                    continue
                 rng, erng = jax.random.split(rng)
                 params, opt_state, losses = epoch_fn(params, opt_state,
                                                      *device_args, erng)
-                it += 1
                 loss_handles.append(jnp.mean(losses))
                 if self.verbose or self.loss_callback is not None:
                     loss_val = float(loss_handles[-1])  # forces a device sync
                     if self.verbose:
                         logger.info("iteration %d loss %f", it, loss_val)
+                    self.metrics.scalar("train/loss", loss_val, step=it)
                     if self.loss_callback is not None:
                         # reference signature: loss_callback(loss, iteration,
                         # partition_id) — HogwildSparkModel.py:99-100; there is
                         # one logical partition here.
                         self.loss_callback(loss_val, it, 0)
+                if (ckpt_mgr is not None and self.checkpoint_every > 0
+                        and (it % self.checkpoint_every == 0 or it == total_epochs)):
+                    ckpt_mgr.save(it, {"params": params, "opt_state": opt_state,
+                                       "epoch": np.int64(it),
+                                       "rng": np.asarray(rng)})
         # block until the last step is done for honest timing
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         # real examples per epoch: padded rows carry zero weight and don't
         # count; stochastic mode counts sampled slots (its actual step volume)
         per_epoch = num_batches * batch if mode == "stochastic" else n
-        seen = per_epoch * it
+        seen = per_epoch * max(it - start_epoch, 0)
         self.params = params
         epoch_losses = [float(l) for l in loss_handles]
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)

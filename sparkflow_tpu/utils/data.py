@@ -51,6 +51,9 @@ class BatchQueue:
         self.batch_size = batch_size
         self.row_dim = row_dim
         self.label_dim = label_dim
+        self._cv = threading.Condition()
+        self._active = 0      # threads currently inside a native call
+        self._closed = False
         self._lib = load_library()
         if self._lib is not None:
             self._q = self._lib.sfq_create(batch_size, row_dim, label_dim,
@@ -65,6 +68,19 @@ class BatchQueue:
             self._shuffle = shuffle
             self._finished = False
 
+    def _enter(self):
+        """Register a native call so close() can drain before freeing."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("queue closed")
+            self._active += 1
+            return self._q
+
+    def _exit(self):
+        with self._cv:
+            self._active -= 1
+            self._cv.notify_all()
+
     # -- producer -----------------------------------------------------------
 
     def push(self, rows: np.ndarray, labels: Optional[np.ndarray] = None) -> None:
@@ -75,9 +91,13 @@ class BatchQueue:
             xp = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
             yp = (labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
                   if labels is not None else None)
-            n = self._lib.sfq_push(self._q, xp, yp, rows.shape[0])
+            handle = self._enter()
+            try:
+                n = self._lib.sfq_push(handle, xp, yp, rows.shape[0])
+            finally:
+                self._exit()
             if n != rows.shape[0]:
-                raise RuntimeError("native queue push failed")
+                raise RuntimeError("queue closed during push")
             return
         for i in range(rows.shape[0]):
             self._stage_x.append(rows[i])
@@ -98,11 +118,22 @@ class BatchQueue:
                 y[i] = self._stage_y[src]
             mask[i] = 1.0
         self._stage_x, self._stage_y = [], []
-        self._pyq.put((x, y, mask, n))
+        while True:  # bounded put that close() can interrupt
+            if self._closed:
+                raise RuntimeError("queue closed")
+            try:
+                self._pyq.put((x, y, mask, n), timeout=0.1)
+                return
+            except _pyqueue.Full:
+                continue
 
     def finish(self) -> None:
         if self._lib is not None:
-            self._lib.sfq_finish(self._q)
+            handle = self._enter()
+            try:
+                self._lib.sfq_finish(handle)
+            finally:
+                self._exit()
             return
         if self._stage_x:
             self._emit()
@@ -123,13 +154,17 @@ class BatchQueue:
             x = np.empty((self.batch_size, self.row_dim), np.float32)
             y = np.empty((self.batch_size, max(self.label_dim, 1)), np.float32)
             mask = np.empty((self.batch_size,), np.float32)
-            n = self._lib.sfq_pop(
-                self._q,
-                x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            handle = self._enter()
+            try:
+                n = self._lib.sfq_pop(
+                    handle,
+                    x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            finally:
+                self._exit()
             if n < 0:
-                raise RuntimeError("native queue pop failed")
+                raise RuntimeError("queue closed during pop")
             if n == 0:
                 return None
             return x, y[:, :self.label_dim], mask, int(n)
@@ -137,9 +172,32 @@ class BatchQueue:
         return item
 
     def close(self) -> None:
-        if self._lib is not None and self._q:
-            self._lib.sfq_destroy(self._q)
-            self._q = None
+        """Tear down safely even with a producer/consumer mid-call: mark
+        closed (wakes blocked native calls), wait for every thread to leave
+        the native layer, then free. Idempotent."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            handle = getattr(self, "_q", None)
+        if self._lib is not None and handle:
+            self._lib.sfq_close(handle)        # wake + fail blocked calls
+            with self._cv:
+                while self._active > 0:
+                    self._cv.wait()
+                self._lib.sfq_destroy(handle)  # drains C++-side inflight too
+                self._q = None
+        elif self._lib is None:
+            # unblock a producer stuck in put() and deliver EOF to consumers
+            try:
+                while True:
+                    self._pyq.get_nowait()
+            except _pyqueue.Empty:
+                pass
+            try:
+                self._pyq.put_nowait(None)
+            except _pyqueue.Full:  # pragma: no cover
+                pass
 
     def __del__(self):  # pragma: no cover
         try:

@@ -1,0 +1,159 @@
+"""localml engine unit tests: params, vectors, dataframe, features, rwlock."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkflow_tpu.localml import (DenseVector, LocalSession,
+                                   MulticlassClassificationEvaluator, Normalizer,
+                                   OneHotEncoder, Row, SparseVector,
+                                   VectorAssembler, Vectors)
+from sparkflow_tpu.localml.param import (Param, Params, TypeConverters,
+                                         keyword_only)
+from sparkflow_tpu.utils.rwlock import RWLock
+
+
+class Thing(Params):
+    alpha = Param(Params._dummy(), "alpha", "a number",
+                  typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, alpha=None):
+        super().__init__()
+        self._setDefault(alpha=1.5)
+        self._set(**self._input_kwargs)
+
+
+def test_param_defaults_and_set():
+    t = Thing()
+    assert t.getOrDefault(t.alpha) == 1.5
+    assert not t.isSet(t.alpha) and t.hasDefault(t.alpha)
+    t2 = Thing(alpha="2")  # converter coerces
+    assert t2.getOrDefault(t2.alpha) == 2.0
+    assert t2.isSet(t2.alpha)
+
+
+def test_param_copy_isolated():
+    t = Thing(alpha=3.0)
+    c = t.copy()
+    c._set(alpha=9.0)
+    assert t.getOrDefault(t.alpha) == 3.0
+    assert c.getOrDefault(c.alpha) == 9.0
+
+
+def test_keyword_only_rejects_positional():
+    with pytest.raises(TypeError):
+        Thing(2.0)
+
+
+def test_vectors():
+    d = Vectors.dense([1.0, 2.0, 3.0])
+    s = Vectors.sparse(3, [0, 2], [1.0, 3.0])
+    assert d.size == 3 and s.size == 3
+    np.testing.assert_allclose(s.toArray(), [1.0, 0.0, 3.0])
+    assert s[2] == 3.0 and s[1] == 0.0
+    assert Vectors.sparse(2, [], []) == Vectors.dense([0.0, 0.0])
+
+
+def test_row_access():
+    r = Row(a=1, b="x")
+    assert r["a"] == 1 and r.b == "x" and "a" in r
+    assert r.asDict() == {"a": 1, "b": "x"}
+    with pytest.raises(KeyError):
+        r["zzz"]
+
+
+def test_dataframe_ops():
+    spark = LocalSession.builder.master("local[3]").getOrCreate()
+    df = spark.createDataFrame([(i, float(i) * 2) for i in range(10)], ["a", "b"])
+    assert df.count() == 10 and df.columns == ["a", "b"]
+    sel = df.select("b")
+    assert sel.columns == ["b"]
+    assert df.rdd.getNumPartitions() == 3
+    assert df.coalesce(1).rdd.getNumPartitions() == 1
+    mapped = df.rdd.map(lambda r: r["a"] + 1).collect()
+    assert mapped == list(range(1, 11))
+    parts = []
+    df.rdd.foreachPartition(lambda it: parts.append(len(list(it))))
+    assert sum(parts) == 10 and len(parts) == 3
+
+
+def test_feature_transformers():
+    spark = LocalSession.builder.getOrCreate()
+    df = spark.createDataFrame([(1.0, 2.0, 0.0), (3.0, 4.0, 2.0)],
+                               ["f1", "f2", "cat"])
+    va = VectorAssembler(inputCols=["f1", "f2"], outputCol="features")
+    out = va.transform(df)
+    np.testing.assert_allclose(out.first()["features"].toArray(), [1.0, 2.0])
+
+    ohe = OneHotEncoder(inputCol="cat", outputCol="oh", dropLast=False)
+    out2 = ohe.transform(df)
+    np.testing.assert_allclose(out2.collect()[1]["oh"].toArray(), [0, 0, 1])
+    # dropLast=True drops the final category (encoded all-zero)
+    ohe2 = OneHotEncoder(inputCol="cat", outputCol="oh")
+    np.testing.assert_allclose(ohe2.transform(df).collect()[1]["oh"].toArray(),
+                               [0, 0])
+
+    nz = Normalizer(inputCol="features", outputCol="norm", p=1.0)
+    np.testing.assert_allclose(nz.transform(out).first()["norm"].toArray(),
+                               [1 / 3, 2 / 3])
+
+
+def test_evaluator_accuracy_and_f1():
+    spark = LocalSession.builder.getOrCreate()
+    df = spark.createDataFrame(
+        [(1.0, 1.0), (0.0, 0.0), (1.0, 0.0), (1.0, 1.0)], ["label", "pred"])
+    ev = MulticlassClassificationEvaluator(labelCol="label", predictionCol="pred",
+                                           metricName="accuracy")
+    assert ev.evaluate(df) == 0.75
+    f1 = MulticlassClassificationEvaluator(labelCol="label", predictionCol="pred",
+                                           metricName="f1").evaluate(df)
+    assert 0.0 < f1 <= 1.0
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,2.5,hello\n3,4.5,world\n")
+    spark = LocalSession.builder.getOrCreate()
+    df = spark.read.option("inferSchema", "true").csv(str(p))
+    rows = df.collect()
+    assert rows[0]["_c0"] == 1 and rows[0]["_c1"] == 2.5 and rows[0]["_c2"] == "hello"
+
+
+def test_rwlock_writer_priority_and_exclusion():
+    lock = RWLock()
+    log = []
+
+    def reader(i):
+        with lock.reading():
+            log.append(("r", i))
+            time.sleep(0.05)
+
+    def writer():
+        with lock.writing():
+            log.append(("w", 0))
+
+    with lock.reading():
+        w = threading.Thread(target=writer)
+        w.start()
+        time.sleep(0.05)
+        # a late reader must queue behind the waiting writer
+        r = threading.Thread(target=reader, args=(99,))
+        r.start()
+        time.sleep(0.05)
+        assert log == []  # nobody got in while we hold the read lock... writer waits
+    w.join(2)
+    r.join(2)
+    assert log[0] == ("w", 0)  # writer won despite the queued reader
+
+
+def test_rwlock_release_any_side():
+    lock = RWLock()
+    lock.acquire_read()
+    lock.release()
+    lock.acquire_write()
+    lock.release()
+    with pytest.raises(RuntimeError):
+        lock.release()
