@@ -1,0 +1,186 @@
+"""Extended benchmark suite (BASELINE.md's config ladder).
+
+Prints one JSON line per benchmark. ``python benchmarks/run_all.py [--quick]``.
+The headline driver metric stays in ``bench.py``; this file tracks the wider
+ladder: MLP / CNN / autoencoder (the reference's three example workloads),
+ResNet-50 CIFAR, BERT-base seq-512 step time, and the flash-attention kernel
+against XLA's naive attention.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+QUICK = "--quick" in sys.argv
+
+
+def _emit(name, value, unit, extra=None):
+    rec = {"benchmark": name, "value": round(float(value), 2), "unit": unit}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _train_eps(graph, input_name, label_name, x, y, batch, epochs, **kw):
+    from sparkflow_tpu.trainer import Trainer
+
+    tr = Trainer(graph, input_name, label_name, optimizer="adam",
+                 mini_batch_size=batch, iters=1, **kw)
+    tr.fit(x, y)                      # warmup/compile epoch
+    tr.iters = epochs
+    res = tr.fit(x, y, init_params=tr.params)
+    return res.examples_per_sec
+
+
+def bench_examples_ladder(compute_dtype):
+    from sparkflow_tpu.models import presets
+
+    n = 2048 if QUICK else 16384
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n)]
+    epochs = 2 if QUICK else 5
+
+    _emit("mnist_mlp_train", _train_eps(presets.mlp(784, 10), "x:0", "y:0",
+                                        x, y, 1024, epochs,
+                                        compute_dtype=compute_dtype),
+          "examples/sec")
+    _emit("mnist_cnn_train", _train_eps(presets.cnn(), "x:0", "y:0",
+                                        x, y, 1024, epochs,
+                                        compute_dtype=compute_dtype),
+          "examples/sec")
+    _emit("mnist_autoencoder_train",
+          _train_eps(presets.autoencoder(784), "x:0", None, x, None, 1024,
+                     epochs, compute_dtype=compute_dtype),
+          "examples/sec")
+
+
+def bench_resnet(compute_dtype):
+    from sparkflow_tpu.models import build_registry_spec
+
+    n = 256 if QUICK else 2048
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n)]
+    spec = build_registry_spec("resnet", num_classes=10,
+                               depth=18 if QUICK else 50, image_size=32,
+                               width=16 if QUICK else 64)
+    _emit("resnet_cifar_train", _train_eps(spec, "x:0", "y:0", x, y,
+                                           64 if QUICK else 256, 2,
+                                           compute_dtype=compute_dtype),
+          "examples/sec", {"depth": 18 if QUICK else 50})
+
+
+def bench_bert_step(compute_dtype):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+    from sparkflow_tpu.optimizers import build_optimizer
+
+    if QUICK:
+        cfg = dict(vocab_size=1000, hidden=128, num_layers=2, num_heads=4,
+                   mlp_dim=256, max_len=128)
+        B = 8
+    else:
+        cfg = dict(vocab_size=30522, hidden=768, num_layers=12, num_heads=12,
+                   mlp_dim=3072, max_len=512)
+        B = 16
+    m = model_from_json(build_registry_spec("transformer_classifier",
+                                            num_classes=2, dropout=0.1, **cfg),
+                        compute_dtype=compute_dtype)
+    params = m.init(jax.random.PRNGKey(0))
+    opt = build_optimizer("adam", 1e-4, None)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, ids, y, rng):
+        def lf(p):
+            return m.loss_vector(p, {"input_ids": ids, "y": y}, train=True,
+                                 rng=rng).mean()
+        loss, g = jax.value_and_grad(lf)(params)
+        u, state = opt.update(g, state, params)
+        return optax.apply_updates(params, u), state, loss
+
+    rs = np.random.RandomState(0)
+
+    def batch(i):
+        return (jnp.asarray(rs.randint(0, cfg["vocab_size"],
+                                       (B, cfg["max_len"])), jnp.int32),
+                jnp.asarray(np.eye(2)[rs.randint(0, 2, B)], jnp.float32))
+
+    ids, y = batch(0)
+    params, state, loss = step(params, state, ids, y, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    n_steps = 3 if QUICK else 8
+    for i in range(n_steps):
+        ids, y = batch(i + 1)
+        params, state, loss = step(params, state, ids, y, jax.random.PRNGKey(i))
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / n_steps
+    _emit("bert_seq512_train_step" if not QUICK else "bert_tiny_train_step",
+          B / dt, "examples/sec", {"ms_per_step": round(dt * 1e3, 1),
+                                   "batch": B, "seq": cfg["max_len"]})
+
+
+def bench_flash_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.ops import attention_reference, flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        # interpret-mode pallas under jit unrolls the whole grid — the number
+        # would measure the interpreter, not the kernel
+        _emit("flash_attention_vs_xla", 0, "speedup_x", {"skipped": "not on tpu"})
+        return
+    S = 1024 if QUICK else 4096
+    rs = np.random.RandomState(0)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    mk = lambda i: jnp.asarray(rs.randn(2, 8, S, 64), dtype)
+
+    f = jax.jit(lambda q: flash_attention(q, q, q, causal=True,
+                                          block_q=512, block_k=512).sum())
+    r = jax.jit(lambda q: attention_reference(q, q, q, causal=True).sum())
+    float(f(mk(0))); float(r(mk(0)))  # compile
+    n = 3
+    t0 = time.perf_counter()
+    for i in range(n):
+        float(f(mk(i + 1)))
+    tf = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for i in range(n):
+        float(r(mk(i + 10)))
+    tr = (time.perf_counter() - t0) / n
+    _emit("flash_attention_vs_xla", tr / tf, "speedup_x",
+          {"seq": S, "flash_ms": round(tf * 1e3, 2), "xla_ms": round(tr * 1e3, 2)})
+
+
+def main():
+    import os
+    import sys as _sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+
+    fallback = ensure_live_backend()
+    import jax
+
+    platform = jax.default_backend()
+    if fallback:
+        platform += " (fallback: accelerator unreachable)"
+    compute_dtype = "bfloat16" if platform == "tpu" else None
+    print(json.dumps({"suite": "sparkflow-tpu-benchmarks",
+                      "platform": platform, "quick": QUICK}), flush=True)
+    bench_examples_ladder(compute_dtype)
+    bench_resnet(compute_dtype)
+    bench_bert_step(compute_dtype)
+    bench_flash_attention()
+
+
+if __name__ == "__main__":
+    main()

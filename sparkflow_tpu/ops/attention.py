@@ -113,33 +113,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
-def flash_attention(q, k, v, causal: bool = False,
-                    sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused attention; [B,H,S,D] -> [B,H,S,D].
-
-    Uses the pallas kernel on TPU when the sequence tiles cleanly; otherwise
-    (CPU tests, odd shapes) the jnp reference path — numerics match to fp
-    tolerance either way.
-    """
+def _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, s, d = q.shape
     sk = k.shape[2]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-
-    on_tpu = jax.default_backend() == "tpu"
-    if interpret is None:
-        interpret = not on_tpu
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
-    # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
-    # (lanes); sequences must tile exactly (pad upstream otherwise)
-    tiles_ok = (pltpu is not None
-                and s % block_q == 0 and sk % block_k == 0
-                and block_q % 8 == 0 and block_k % 128 == 0 and d % 8 == 0)
-    if not tiles_ok:
-        return attention_reference(q, k, v, causal, scale)
-
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -168,6 +144,90 @@ def flash_attention(q, k, v, causal: bool = False,
     return out.reshape(b, h, s, d)
 
 
+def _blockwise_attention(q, k, v, causal, scale, block_k=512):
+    """Differentiable blockwise attention in pure jnp: lax.scan over K/V
+    blocks with the online-softmax fold, each block rematerialized — O(S*block)
+    live memory instead of O(S^2). This is the autodiff path behind the pallas
+    kernel's custom_vjp (gradients recompute flash-style; the S x S score
+    matrix never materializes in either direction)."""
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    if sk % block_k:
+        return attention_reference(q, k, v, causal, scale)
+    nblk = sk // block_k
+    kb = k.reshape(b, h, nblk, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    @jax.checkpoint
+    def fold(carry, blk):
+        acc, m, l = carry
+        kc, vc, idx = blk
+        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, 0, idx * block_k)
+        return _merge_stats(acc, m, l, a2, m2, l2), None
+
+    init = (jnp.zeros((b, h, s, d), jnp.float32),
+            jnp.full((b, h, s, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s, 1), jnp.float32))
+    (acc, m, l), _ = jax.lax.scan(fold, init, (kb, vb, jnp.arange(nblk)))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_attention(q, k, v, causal, scale,
+                                             block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention; [B,H,S,D] -> [B,H,S,D].
+
+    Forward runs the pallas kernel on TPU when the sequence tiles cleanly
+    (otherwise the jnp reference path — numerics match to fp tolerance).
+    Backward goes through a custom VJP: gradients recompute attention
+    blockwise (flash-style, no S x S materialization), since pallas kernels
+    have no automatic autodiff rule.
+    """
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
+    # (lanes); sequences must tile exactly (pad upstream otherwise)
+    tiles_ok = (pltpu is not None
+                and s % block_q == 0 and sk % block_k == 0
+                and block_q % 8 == 0 and block_k % 128 == 0 and d % 8 == 0)
+    if not tiles_ok:
+        return attention_reference(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
 # ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
@@ -189,6 +249,15 @@ def _block_stats(q, k, v, scale, causal, q_offset, k_offset, kv_mask=None):
     acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
     return acc, m, l
+
+
+def _merge_stats(acc, m, l, a2, m2, l2):
+    """Fold one blockwise (acc, max, sum) triple into the running online
+    -softmax state — shared by ring attention and the flash backward."""
+    m_new = jnp.maximum(m, m2)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m2 - m_new)
+    return acc * alpha + a2 * beta, m_new, l * alpha + l2 * beta
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -217,11 +286,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         k_offset = src * s_local
         a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, q_offset, k_offset,
                                   mc if have_mask else None)
-        m_new = jnp.maximum(m, m2)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m2 - m_new)
-        acc = acc * alpha + a2 * beta
-        l = l * alpha + l2 * beta
+        acc, m_new, l = _merge_stats(acc, m, l, a2, m2, l2)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         if have_mask:

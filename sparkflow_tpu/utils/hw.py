@@ -1,0 +1,47 @@
+"""Hardware liveness helpers shared by the benchmark drivers.
+
+The TPU relay in some environments can wedge such that *any* jax backend init
+hangs forever (even ``jax.devices()``). Benchmark entry points probe liveness
+in a subprocess first and force CPU when the accelerator is unreachable — a
+completed CPU run with an honest note beats a hung driver.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def tpu_alive(timeout_s: int = 120) -> bool:
+    """True if a fresh process can run a trivial jitted op on the default
+    backend within the timeout."""
+    code = ("import jax, jax.numpy as jnp;"
+            "print(float(jax.jit(lambda x: (x*1.0).sum())(jnp.ones((8,8)))))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_live_backend(timeout_s: int = 120) -> bool:
+    """Probe the default backend; on failure force CPU (env + config, before
+    any jax import in this process). Returns True when a fallback happened.
+
+    Must be called BEFORE importing jax anywhere in the process. If forcing
+    CPU fails too, raises rather than letting the caller hang on TPU init.
+    """
+    explicit_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    if explicit_cpu or tpu_alive(timeout_s):
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"  # covers child processes
+    import jax  # first import in this process
+
+    jax.config.update("jax_platforms", "cpu")  # beats sitecustomize overrides
+    # prove it: a trivial op must complete on CPU
+    import jax.numpy as jnp
+
+    float(jax.jit(lambda x: x.sum())(jnp.ones((2,))))
+    return True

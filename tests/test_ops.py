@@ -31,6 +31,19 @@ def test_flash_causal_matches_reference(qkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
 
 
+def test_flash_attention_differentiable(qkv):
+    """Regression: pallas kernels have no autodiff rule; the custom VJP must
+    give reference-exact gradients (this crashed BERT training when missing)."""
+    q, k, v = qkv
+    for causal in (False, True):
+        gf = jax.grad(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, interpret=True).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: attention_reference(
+            a, b, c, causal=causal).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
 def test_flash_fallback_odd_shapes():
     """Non-tiling sequences take the jnp path and still match."""
     rs = np.random.RandomState(1)
