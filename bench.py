@@ -19,8 +19,7 @@ import numpy as np
 def main():
     from sparkflow_tpu.utils.hw import ensure_live_backend
 
-    if ensure_live_backend():
-        os.environ["SPARKFLOW_TPU_BENCH_FALLBACK"] = "1"
+    fell_back = ensure_live_backend()
 
     import jax
 
@@ -29,8 +28,8 @@ def main():
     from sparkflow_tpu.trainer import Trainer
     from sparkflow_tpu.parallel.mesh import default_mesh
 
-    fallback = bool(os.environ.get("SPARKFLOW_TPU_BENCH_FALLBACK"))
-    quick = "--quick" in sys.argv or fallback  # CPU fallback: smallest honest run
+    quick = "--quick" in sys.argv or fell_back  # CPU fallback: smallest honest run
+    fallback = fell_back
 
     def cnn_model():
         x = nn.placeholder([None, 784], name="x")
@@ -81,7 +80,7 @@ def main():
         "unit": "examples/sec",
         "vs_baseline": vs_baseline,
     }
-    if os.environ.get("SPARKFLOW_TPU_BENCH_FALLBACK"):
+    if fallback:
         out["note"] = "tpu unreachable at bench time; measured on CPU fallback"
     print(json.dumps(out))
 

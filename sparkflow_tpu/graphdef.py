@@ -421,6 +421,20 @@ def _params_layer_norm(node, ins):
     return {"scale": ((d,), "ones"), "bias": ((d,), "zeros")}
 
 
+def _eval_batch_norm(node, ins, ctx, p):
+    """Batch-statistics normalization over all non-channel axes + learned
+    scale/shift. Deliberately stateless (no running averages): moving stats are
+    cross-step mutable state that breaks pure-functional training; for
+    train/serve parity prefer layer_norm/group_norm (what the zoo models use)."""
+    x = ins[0].astype(jnp.float32)
+    eps = node.attrs.get("epsilon", 1e-5)
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return _cast(y, ctx.compute_dtype)
+
+
 def _eval_layer_norm(node, ins, ctx, p):
     x = ins[0].astype(jnp.float32)
     eps = node.attrs.get("epsilon", 1e-6)
@@ -562,12 +576,14 @@ OPS: Dict[str, _OpDef] = {
     "concat": _OpDef(_infer_concat,
                      lambda n, i, c: jnp.concatenate(list(i), axis=n.attrs.get("axis", -1))),
     "layer_norm": _OpDef(_infer_elementwise, None, _params_layer_norm),
+    "batch_norm": _OpDef(_infer_elementwise, None, _params_layer_norm),
     "embedding": _OpDef(lambda n, i: tuple(i[0]) + (n.attrs["dim"],), None, _params_embedding),
 }
 
 OPS["dense"].eval = _eval_dense
 OPS["conv2d"].eval = _eval_conv2d
 OPS["layer_norm"].eval = _eval_layer_norm
+OPS["batch_norm"].eval = _eval_batch_norm
 OPS["embedding"].eval = lambda n, i, c, p: jnp.take(p["embedding"], i[0].astype(jnp.int32), axis=0)
 
 for _name, _act in _ACTIVATIONS.items():

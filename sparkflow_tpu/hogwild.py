@@ -86,6 +86,17 @@ class HogwildTrainer:
         """No server exists; kept so reference-style cleanup code runs
         (``tests/dl_runner.py:209-214``)."""
 
+    @staticmethod
+    def determine_master(port: Optional[int] = None) -> str:
+        """Reference API parity (``HogwildSparkModel.determine_master``,
+        ``HogwildSparkModel.py:145-154``): resolves a coordinator address.
+        The reference's default was the Flask port (5000), which no longer
+        exists; with no argument this now matches
+        :func:`parallel.distributed.determine_master` so both bootstrap paths
+        agree on the address."""
+        from .parallel.distributed import determine_master as _dm
+        return _dm(port) if port is not None else _dm()
+
     # reference attribute some callers poke at
     @property
     def server(self):

@@ -93,3 +93,7 @@ def attach_pretrained_model_to_pipeline(checkpoint_path: str, graph_json: str,
     model = load_checkpoint_model(checkpoint_path, graph_json, inputCol,
                                   tfInput, tfOutput, predictionCol)
     return PipelineModel(stages=list(pipeline_model.stages) + [model])
+
+
+# reference-named alias (same role; native checkpoint formats)
+attach_tensorflow_model_to_pipeline = attach_pretrained_model_to_pipeline

@@ -261,6 +261,12 @@ def layer_norm(x: Sym, epsilon: float = 1e-6, name: Optional[str] = None) -> Sym
     return _op("layer_norm", [x], {"epsilon": epsilon}, name or "layer_norm")
 
 
+def batch_norm(x: Sym, epsilon: float = 1e-5, name: Optional[str] = None) -> Sym:
+    """Batch-statistics normalization (stateless — see graphdef._eval_batch_norm
+    for the train/serve caveat vs TF1's moving averages)."""
+    return _op("batch_norm", [x], {"epsilon": epsilon}, name or "batch_norm")
+
+
 def embedding(ids: Sym, vocab_size: int, dim: int, name: Optional[str] = None) -> Sym:
     return _op("embedding", [ids], {"vocab_size": int(vocab_size), "dim": int(dim)},
                name or "embedding")

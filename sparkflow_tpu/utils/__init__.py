@@ -1,5 +1,15 @@
-"""Utilities: data plane binding, profiling/tracing, structured metrics."""
+"""Utilities: data plane binding, profiling/tracing, metrics, locks, hw probes.
 
-from . import data, metrics, tracing
+Submodules import lazily so lightweight ones (``hw``, ``rwlock``) can load
+without pulling in jax via ``tracing``/``data``.
+"""
 
-__all__ = ["data", "metrics", "tracing"]
+import importlib
+
+__all__ = ["data", "metrics", "tracing", "rwlock", "hw"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

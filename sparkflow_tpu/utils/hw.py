@@ -27,11 +27,13 @@ def tpu_alive(timeout_s: int = 120) -> bool:
 
 
 def ensure_live_backend(timeout_s: int = 120) -> bool:
-    """Probe the default backend; on failure force CPU (env + config, before
-    any jax import in this process). Returns True when a fallback happened.
+    """Probe the default backend; on failure force CPU. Returns True when a
+    fallback happened.
 
-    Must be called BEFORE importing jax anywhere in the process. If forcing
-    CPU fails too, raises rather than letting the caller hang on TPU init.
+    Must run before any jax *device use* in this process (importing jax is
+    fine — backends initialize on first device access, and the config update
+    below still wins then). If forcing CPU fails too, this raises rather than
+    letting the caller hang on a wedged accelerator init.
     """
     explicit_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     if explicit_cpu or tpu_alive(timeout_s):
