@@ -21,14 +21,13 @@ num_layers=12, num_heads=12, mlp_dim=3072, max_len=512, num_classes=N)``.
 
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops import attention_reference, flash_attention, ring_attention
+from ..ops import flash_attention, ring_attention
 from .base import RegistryModel
 from .registry import register_model
 
@@ -124,20 +123,9 @@ class _TransformerBase(RegistryModel):
         if self.sp_axis is not None:
             return ring_attention(q, k, v, self.sp_axis, causal=causal,
                                   kv_mask=mask)
-        if mask is not None:
-            # additive key mask -> masked reference path (flash kernel grows a
-            # mask argument in a later round)
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                           preferred_element_type=jnp.float32)
-            s = s / math.sqrt(self.head_dim)
-            s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
-            if causal:
-                qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
-                ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
-                s = jnp.where(qi >= ki, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-        return flash_attention(q, k, v, causal=causal)
+        # the kernel takes the key-padding mask directly; odd shapes fall back
+        # to the blockwise/reference paths inside flash_attention
+        return flash_attention(q, k, v, causal=causal, kv_mask=mask)
 
     def _block(self, bp, x, mask, causal, train, rng):
         b, s, h = x.shape

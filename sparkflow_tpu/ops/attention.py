@@ -47,8 +47,9 @@ NEG_INF = -1e30
 
 def attention_reference(q, k, v, causal: bool = False,
                         sm_scale: Optional[float] = None,
-                        q_offset: int = 0, k_offset: int = 0):
-    """Plain softmax attention, f32 accumulation. Shapes [B,H,S,D]."""
+                        q_offset: int = 0, k_offset: int = 0, kv_mask=None):
+    """Plain softmax attention, f32 accumulation. Shapes [B,H,S,D];
+    ``kv_mask`` [B,S_k] masks padded keys (1 = attend)."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -56,6 +57,8 @@ def attention_reference(q, k, v, causal: bool = False,
         qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0) + q_offset
         ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1) + k_offset
         s = jnp.where(qi >= ki, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -65,8 +68,13 @@ def attention_reference(q, k, v, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
+                  block_q: int, block_k: int, has_mask: bool):
+    if has_mask:
+        mask_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        mask_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -88,6 +96,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if mask_ref is not None:  # [1, block_k] key-padding mask for this batch row
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
 
         m_prev = m_ref[:]                          # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -113,23 +123,33 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
+                          interpret):
     b, h, s, d = q.shape
     sk = k.shape[2]
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
+    has_mask = kv_mask is not None
 
     kernel = functools.partial(_flash_kernel, sm_scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    args = [qf, kf, vf]
+    if has_mask:
+        # per-batch key mask [B, Sk]; block row selected by bh // h
+        in_specs.append(pl.BlockSpec((1, block_k),
+                                     lambda bh, qi, ki, _h=h: (bh // _h, ki)))
+        args.append(kv_mask.astype(jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[
@@ -140,11 +160,11 @@ def _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out.reshape(b, h, s, d)
 
 
-def _blockwise_attention(q, k, v, causal, scale, block_k=512):
+def _blockwise_attention(q, k, v, kv_mask, causal, scale, block_k=512):
     """Differentiable blockwise attention in pure jnp: lax.scan over K/V
     blocks with the online-softmax fold, each block rematerialized — O(S*block)
     live memory instead of O(S^2). This is the autodiff path behind the pallas
@@ -154,44 +174,51 @@ def _blockwise_attention(q, k, v, causal, scale, block_k=512):
     sk = k.shape[2]
     block_k = min(block_k, sk)
     if sk % block_k:
-        return attention_reference(q, k, v, causal, scale)
+        # can't tile: the dense reference path, mask honored
+        return attention_reference(q, k, v, causal, scale, kv_mask=kv_mask)
     nblk = sk // block_k
     kb = k.reshape(b, h, nblk, block_k, d).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(b, h, nblk, block_k, d).transpose(2, 0, 1, 3, 4)
+    if kv_mask is not None:
+        mb = kv_mask.reshape(b, nblk, block_k).transpose(1, 0, 2)
+    else:
+        mb = jnp.ones((nblk, b, 1), jnp.float32)  # dummy, unused
 
     @jax.checkpoint
     def fold(carry, blk):
         acc, m, l = carry
-        kc, vc, idx = blk
-        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, 0, idx * block_k)
+        kc, vc, mc, idx = blk
+        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, 0, idx * block_k,
+                                  mc if kv_mask is not None else None)
         return _merge_stats(acc, m, l, a2, m2, l2), None
 
     init = (jnp.zeros((b, h, s, d), jnp.float32),
             jnp.full((b, h, s, 1), NEG_INF, jnp.float32),
             jnp.zeros((b, h, s, 1), jnp.float32))
-    (acc, m, l), _ = jax.lax.scan(fold, init, (kb, vb, jnp.arange(nblk)))
+    (acc, m, l), _ = jax.lax.scan(fold, init, (kb, vb, mb, jnp.arange(nblk)))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k,
-                                 interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    return _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q,
+                                 block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_pallas_forward(q, k, v, causal, scale, block_q, block_k,
-                                interpret)
-    return out, (q, k, v)
+def _flash_fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
+    out = _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q,
+                                block_k, interpret)
+    return out, (q, k, v, kv_mask)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, kv_mask = res
     _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_attention(q, k, v, causal, scale,
+        lambda q, k, v: _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                              block_k=block_k),
         q, k, v)
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None  # mask carries no gradient
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -200,8 +227,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused attention; [B,H,S,D] -> [B,H,S,D].
+                    interpret: Optional[bool] = None,
+                    kv_mask=None):
+    """Fused attention; [B,H,S,D] -> [B,H,S,D]. ``kv_mask`` is an optional
+    [B, S_k] key-padding mask (1 = attend).
 
     Forward runs the pallas kernel on TPU when the sequence tiles cleanly
     (otherwise the jnp reference path — numerics match to fp tolerance).
@@ -224,8 +253,13 @@ def flash_attention(q, k, v, causal: bool = False,
                 and s % block_q == 0 and sk % block_k == 0
                 and block_q % 8 == 0 and block_k % 128 == 0 and d % 8 == 0)
     if not tiles_ok:
-        return attention_reference(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        if kv_mask is None:
+            return attention_reference(q, k, v, causal, scale)
+        # blockwise keeps memory bounded when it tiles; its own fallback is
+        # the dense reference path with the mask honored
+        return _blockwise_attention(q, k, v, kv_mask, causal, scale,
+                                    block_k=block_k)
+    return _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret)
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,25 @@ def test_flash_attention_differentiable(qkv):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
 
 
+def test_flash_kv_mask_matches_reference(qkv):
+    """The kernel's key-padding mask path (fwd + bwd) vs additive-mask ref."""
+    q, _, _ = qkv
+    rs = np.random.RandomState(7)
+    mask = jnp.asarray((rs.rand(2, 256) > 0.3).astype(np.float32))
+
+    def ref(qq):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qq, qq) / np.sqrt(qq.shape[-1])
+        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), qq)
+
+    out = flash_attention(q, q, q, kv_mask=mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q)), atol=1e-4)
+    gf = jax.grad(lambda a: flash_attention(a, a, a, kv_mask=mask,
+                                            interpret=True).sum())(q)
+    gr = jax.grad(lambda a: ref(a).sum())(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=1e-3)
+
+
 def test_flash_fallback_odd_shapes():
     """Non-tiling sequences take the jnp path and still match."""
     rs = np.random.RandomState(1)
