@@ -1,0 +1,24 @@
+"""The driver's entry points must stay green: entry() jits; dryrun covers
+dp/tp/sp/pp/ep on the virtual mesh."""
+
+import sys
+
+import jax
+import numpy as np
+
+
+def test_entry_forward_jits():
+    sys.path.insert(0, ".")
+    import __graft_entry__ as g
+
+    fn, (params, x) = g.entry()
+    out = jax.jit(fn)(params, x)
+    assert out.shape == (64, 10)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_dryrun_multichip_8():
+    sys.path.insert(0, ".")
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)  # raises on any non-finite loss or shard failure
