@@ -202,6 +202,42 @@ def test_random_model_10in(spark):
     assert calculate_errors(model.transform(df)) < 150
 
 
+def test_weights_side_file_and_checkpointing(spark, gaussian_df, tmp_path):
+    """Upgrade params: weightsPath (npz side-file) + checkpointDir/Every."""
+    mg = build_graph(create_model)
+    wp = str(tmp_path / "w")
+    ck = str(tmp_path / "ck")
+    est = base_estimator(mg, iters=6, weightsPath=wp, checkpointDir=ck,
+                         checkpointEvery=3)
+    calls = []
+    est.setLossCallback(lambda loss, it, pid: calls.append((it, pid)))
+    model = est.fit(gaussian_df)
+    assert model.getOrDefault(model.modelWeights).startswith("npz:")
+    assert calls and calls[0] == (1, 0)
+    from sparkflow_tpu.checkpoint import CheckpointManager
+    assert CheckpointManager(ck).all_steps()  # periodic checkpoints written
+    assert calculate_errors(model.transform(gaussian_df)) < 400
+
+
+def test_fit_stream_on_dp_mesh(dp_mesh):
+    """Streaming ingest with the batch dimension sharded over dp."""
+    import sparkflow_tpu.nn as nn2
+    from sparkflow_tpu.trainer import Trainer
+
+    def m():
+        x = nn2.placeholder([None, 6], name="x")
+        y = nn2.placeholder([None, 1], name="y")
+        nn2.sigmoid_cross_entropy(y, nn2.dense(x, 1, name="out"))
+
+    rs = np.random.RandomState(0)
+    M = rs.randn(500, 6).astype(np.float32)
+    lbl = (M @ rs.randn(6) > 0).astype(np.float32)
+    tr = Trainer(build_graph(m), "x:0", "y:0", mini_batch_size=64,
+                 learning_rate=0.2, mesh=dp_mesh)
+    res = tr.fit_stream(zip(list(M), list(lbl)))
+    assert res.losses[-1] < res.losses[0]
+
+
 def test_one_hot_pipeline_accuracy(spark):
     """Full pipeline with OneHotEncoder + evaluator (examples/simple_dnn.py shape)."""
     rs = np.random.RandomState(7)
