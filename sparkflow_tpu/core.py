@@ -79,6 +79,24 @@ def _step_body(loss_fn: Callable, optimizer: optax.GradientTransformation) -> Ca
     return step
 
 
+def _sharded_trace_guard(fn: Callable, mesh: Mesh) -> Callable:
+    """On a >1-device mesh, trace ``fn`` under
+    :func:`~sparkflow_tpu.ops.attention.force_xla_attention` — pallas custom
+    calls have no GSPMD partitioning rule, so sharded programs must take the
+    XLA blockwise attention path (single-device meshes keep the kernel)."""
+    if mesh.size <= 1:
+        return fn
+
+    from .ops.attention import force_xla_attention
+
+    @functools.wraps(fn)
+    def guarded(*args):
+        with force_xla_attention():
+            return fn(*args)
+
+    return guarded
+
+
 def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None) -> Callable:
     """One jitted optimizer step.
@@ -92,6 +110,7 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
 
+    step = _sharded_trace_guard(step, mesh)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("dp"))
     return jax.jit(
@@ -104,7 +123,8 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
 def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   batch_size: int, num_batches: int, mode: str,
-                  shuffle: bool, mesh: Optional[Mesh] = None) -> Callable:
+                  shuffle: bool, mesh: Optional[Mesh] = None,
+                  n_real: Optional[int] = None) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -127,14 +147,23 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
         perm_rng, rng = jax.random.split(rng)
         if mode == "stochastic":
             # num_batches independent mini-batches, each sampled without
-            # replacement (reference: np.random.choice(..., replace=False) per
-            # batch, sparkflow/ml_util.py:121-127). Concatenated permutations
-            # guarantee uniqueness within every batch_size-aligned window while
-            # allowing num_batches to exceed one sweep of the data.
-            n_perms = -(-used // data.shape[0])
-            idx = jnp.concatenate(
-                [jax.random.permutation(r, data.shape[0])
-                 for r in jax.random.split(perm_rng, n_perms)])[:used]
+            # replacement from the n_real REAL rows only (reference:
+            # np.random.choice(..., replace=False) per batch,
+            # sparkflow/ml_util.py:121-127) — zero-weight padding rows never
+            # occupy batch slots, so every batch trains on batch_size real
+            # examples (unless the batch exceeds the dataset, where the
+            # remainder is masked padding).
+            nr = n_real if n_real is not None else data.shape[0]
+
+            def batch_idx(r):
+                perm = jax.random.permutation(r, nr)
+                if batch_size <= nr:
+                    return perm[:batch_size]
+                filler = jnp.arange(nr, batch_size)  # padded rows, mask == 0
+                return jnp.concatenate([perm, filler])
+
+            idx = jax.vmap(batch_idx)(
+                jax.random.split(perm_rng, num_batches)).reshape(-1)
             data_e = jnp.take(data, idx, axis=0)
             labels_e = jnp.take(labels, idx, axis=0)
             mask_e = jnp.take(mask, idx, axis=0)
@@ -166,6 +195,7 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
     if mesh is None:
         return jax.jit(epoch, donate_argnums=(0, 1))
 
+    epoch = _sharded_trace_guard(epoch, mesh)
     repl = NamedSharding(mesh, P())
     rows = NamedSharding(mesh, P("dp"))  # dataset rows sharded over dp; XLA
     # re-shards each scanned batch and all-reduces gradients over ICI

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import csv as _csv
 import random as _random
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -98,6 +98,12 @@ class RDD:
     def coalesce(self, n: int) -> "RDD":
         return RDD(self.items, min(self.num_partitions, max(1, n)))
 
+    def persist(self, *_a) -> "RDD":
+        return self  # local lists are always materialized
+
+    def unpersist(self, *_a) -> "RDD":
+        return self
+
     def repartition(self, n: int) -> "RDD":
         items = list(self.items)
         _random.Random(17).shuffle(items)
@@ -107,6 +113,13 @@ class RDD:
 
     def collect(self) -> List[Any]:
         return list(self.items)
+
+    def toLocalIterator(self) -> Iterator[Any]:
+        """Partition-by-partition generator (pyspark's streaming action: the
+        driver holds one partition at a time, never the whole dataset)."""
+        for part in _slice(self.items, self.num_partitions):
+            for x in part:
+                yield x
 
     def count(self) -> int:
         return len(self.items)

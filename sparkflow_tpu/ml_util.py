@@ -19,7 +19,9 @@ Mirrors ``sparkflow/ml_util.py`` function-for-function, re-based on JAX:
 
 from __future__ import annotations
 
+import hashlib
 import json
+from collections import OrderedDict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -73,7 +75,11 @@ def json_to_params(model: GraphModel, json_weights: str):
 # Inference kernel
 # ---------------------------------------------------------------------------
 
-_PREDICT_CACHE: Dict[Tuple[int, str, Optional[str], float], Any] = {}
+# Keyed on the sha256 of the full graph JSON (not the 64-bit string hash — a
+# collision there would silently serve the wrong model) and LRU-bounded so
+# long-lived processes serving many models don't leak compiled programs.
+_PREDICT_CACHE: "OrderedDict[Tuple[str, str, str, Optional[str], float], Any]" = OrderedDict()
+_PREDICT_CACHE_MAX = 32
 
 
 def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
@@ -81,12 +87,17 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
     """Cache (model, predict_fn) across partitions — the reference rebuilt the
     whole session per partition (``ml_util.py:61-68``); one compiled program
     serves all partitions here."""
-    key = (hash(graph_json), tf_output, tf_input, tf_dropout, dropout_value)
+    digest = hashlib.sha256(graph_json.encode()).hexdigest()
+    key = (digest, tf_output, tf_input, tf_dropout, dropout_value)
     if key not in _PREDICT_CACHE:
         from .models import model_from_json
         model = model_from_json(graph_json)
         fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)
         _PREDICT_CACHE[key] = (model, fn)
+        while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
+            _PREDICT_CACHE.popitem(last=False)
+    else:
+        _PREDICT_CACHE.move_to_end(key)
     return _PREDICT_CACHE[key]
 
 

@@ -60,27 +60,163 @@ def load_checkpoint_model(checkpoint_path: str,
         predictionCol=predictionCol)
 
 
+# optimizer slot variables a TF1 Saver checkpoint carries alongside the
+# trainables; the reference imported tf.trainable_variables() only
+# (tensorflow_model_loader.py:23-24). Matched as full path SEGMENTS so a
+# legitimate layer scope like "power_head" or "global_step_embed" is kept.
+_TF_SLOT_SEGMENTS = frozenset(
+    ["Adam", "Adam_1", "Momentum", "RMSProp", "RMSProp_1", "Adadelta",
+     "Adagrad", "Ftrl", "Ftrl_1", "beta1_power", "beta2_power",
+     "global_step", "save_counter", "_CHECKPOINTABLE_OBJECT_GRAPH"])
+
+
+def _is_tf_slot_variable(name: str) -> bool:
+    return any(seg in _TF_SLOT_SEGMENTS for seg in name.split("/"))
+
+
+def _tf_scope_sort_key(name: str):
+    """Creation order of tf.layers-style variable names: ``dense/kernel`` <
+    ``dense_1/kernel`` < ``dense_2/bias``; within a scope kernel before bias
+    (TF1 layer creation order)."""
+    import re
+    scope = name.rsplit("/", 1)[0]
+    leaf = name.rsplit("/", 1)[-1]
+    m = re.match(r"^(.*?)(?:_(\d+))?$", scope)
+    base, idx = m.group(1), int(m.group(2) or 0)
+    leaf_rank = {"kernel": 0, "weights": 0, "w": 0,
+                 "bias": 1, "biases": 1, "b": 1}.get(leaf, 2)
+    return (base, idx, leaf_rank, leaf)
+
+
+def _read_tf_variables(checkpoint_path: str):
+    """name -> array for a TF checkpoint's non-slot variables, in TF1
+    layer-naming order (``dense`` < ``dense_1``, kernel before bias). TF is
+    required for reading only; no graph ever executes."""
+    try:
+        import tensorflow as tf
+    except ImportError as e:
+        raise ImportError(
+            "reading TF1 checkpoints needs TensorFlow installed; for native "
+            "checkpoints use load_checkpoint_model (npz/orbax)") from e
+    reader = tf.train.load_checkpoint(checkpoint_path)
+    names = sorted((n for n in reader.get_variable_to_shape_map()
+                    if not _is_tf_slot_variable(n)),
+                   key=_tf_scope_sort_key)
+    return {n: np.asarray(reader.get_tensor(n)) for n in names}
+
+
+def extract_tensorflow_weights(checkpoint_path: str,
+                               var_order: Optional[List[str]] = None
+                               ) -> List[np.ndarray]:
+    """Read a TF1 Saver (or TF2) checkpoint's variables into a flat weight
+    list WITHOUT executing any TF graph — ``tf.train.load_checkpoint`` reads
+    tensors straight off the checkpoint shards (reference behavior:
+    ``sess.run(tf.trainable_variables())``, ``tensorflow_model_loader.py:
+    16-24``). Optimizer slot variables are excluded.
+
+    Order: ``var_order`` (explicit checkpoint variable names) when given,
+    else TF1 layer-*naming* order (``dense`` < ``dense_1`` < ..., kernel
+    before bias). NOTE: checkpoints record no creation order, so for
+    auto-numbered ``tf.layers``-style names this matches
+    ``tf.trainable_variables``, but hand-named scopes sort alphabetically —
+    use ``var_order`` (or :func:`load_tensorflow_model`'s shape matching)
+    for those.
+    """
+    allv = _read_tf_variables(checkpoint_path)
+    if var_order is not None:
+        missing = [n for n in var_order if n not in allv]
+        if missing:
+            raise KeyError(f"variables {missing} not in checkpoint "
+                           f"{checkpoint_path} (has: {sorted(allv)})")
+        return [allv[n] for n in var_order]
+    return list(allv.values())
+
+
+def _match_tf_weights_to_graph(allv, model) -> List[np.ndarray]:
+    """Assign checkpoint variables to the graph's flat param slots by SHAPE
+    (name order breaks ties). Cross-layer swaps between different-shaped
+    layers are impossible this way; same-shape groups keep name order and
+    emit a warning since the checkpoint records no creation order."""
+    import logging
+    unused = list(allv.items())
+    flat_specs = [(lname, pname, tuple(shape))
+                  for lname, pspec in model.param_specs().items()
+                  for pname, (shape, _init) in pspec.items()]
+    if len(unused) != len(flat_specs):
+        raise ValueError(
+            f"checkpoint has {len(unused)} variables; graph needs "
+            f"{len(flat_specs)}")
+    out, ambiguous = [], set()
+    for lname, pname, shape in flat_specs:
+        cands = [i for i, (_n, a) in enumerate(unused) if a.shape == shape]
+        if not cands:
+            raise ValueError(
+                f"no checkpoint variable with shape {shape} left for "
+                f"{lname}/{pname}; remaining: "
+                f"{[(n, a.shape) for n, a in unused]}")
+        if len(cands) > 1:
+            ambiguous.add(shape)
+        out.append(unused.pop(cands[0])[1])
+    if ambiguous:
+        logging.getLogger("sparkflow_tpu").warning(
+            "TF checkpoint import: multiple variables share shape(s) %s; "
+            "assignment within those groups follows name order, which may "
+            "not be creation order — pass var_order= to pin it.",
+            sorted(ambiguous))
+    return out
+
+
 def load_tensorflow_model(path: str,
                           inputCol: str,
                           tfInput: str,
                           tfOutput: str,
                           predictionCol: str = "predicted",
                           tfDropout: Optional[str] = None,
-                          toKeepDropout: bool = False):
-    """Import a TF1 Saver checkpoint's trainable variables (requires an
-    installed TensorFlow AND a graph re-expressed in the nn DSL: TF1 protobuf
-    graphs are not executable here). Provided for weight migration only."""
+                          toKeepDropout: bool = False,
+                          graph_json: Optional[str] = None,
+                          var_order: Optional[List[str]] = None) -> SparkAsyncDLModel:
+    """Import a TF1 Saver checkpoint into a fitted ``SparkAsyncDLModel``
+    (reference ``load_tensorflow_model``, ``tensorflow_model_loader.py:8-32``).
+
+    The reference re-animated the checkpoint's MetaGraphDef in a tf.Session;
+    TF1 protobuf graphs are not executable here, so the serving graph must be
+    supplied as ``graph_json`` (the same model re-expressed in the
+    :mod:`sparkflow_tpu.nn` DSL — shape-validated against the checkpoint).
+    Weights are extracted directly from the checkpoint shards; TF is required
+    only for reading, never executed.
+    """
+    if graph_json is None:
+        raise ValueError(
+            "graph_json is required: TF1 MetaGraphDef graphs cannot execute "
+            "on this framework — rebuild the model with sparkflow_tpu.nn "
+            "(same layer order) and pass its build_graph() JSON here.")
+    from .graphdef import list_to_params
+    from .models import model_from_json
+    model = model_from_json(graph_json)
     try:
-        import tensorflow as tf  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "load_tensorflow_model needs TensorFlow installed to read TF1 "
-            "checkpoints; for native checkpoints use load_checkpoint_model "
-            "(npz/orbax)") from e
-    raise NotImplementedError(
-        "TF1 MetaGraphDef graphs cannot execute on this framework; rebuild the "
-        "model with sparkflow_tpu.nn and import the weights via "
-        "load_checkpoint_model(save_weights_npz(...)).")
+        if var_order is not None:
+            weights = extract_tensorflow_weights(path, var_order=var_order)
+        else:
+            # shape-driven assignment: immune to scope names that don't sort
+            # in creation order (checkpoints record names, not order)
+            weights = _match_tf_weights_to_graph(_read_tf_variables(path),
+                                                 model)
+        list_to_params(model, weights)  # shape/count validation
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            f"checkpoint variables do not match graph_json params: {e}. "
+            f"If the checkpoint uses non-standard variable naming, pass "
+            f"var_order= with the checkpoint variable names in graph layer "
+            f"order.") from e
+    return SparkAsyncDLModel(
+        inputCol=inputCol,
+        modelJson=graph_json,
+        modelWeights=convert_weights_to_json(weights),
+        tfInput=tfInput,
+        tfOutput=tfOutput,
+        tfDropout=tfDropout,
+        toKeepDropout=toKeepDropout,
+        predictionCol=predictionCol)
 
 
 def attach_pretrained_model_to_pipeline(checkpoint_path: str, graph_json: str,

@@ -1,17 +1,24 @@
 """Mixture-of-Experts transformer with expert parallelism over ``ep``.
 
-Switch-style top-1 routing with a load-balancing auxiliary loss (Fedus et al.,
-Switch Transformer; retrieved PAPERS.md pattern). Experts live stacked on a
-leading axis sharded over the ``ep`` mesh axis (``param_pspecs``), so with
-E == ep-size each device stores and computes exactly one expert's FFN over the
-token stream and GSPMD inserts the combine reduction over ICI — expert
-parallelism without manual all_to_all. Token-level hard capacity (dropping) is
-a later scheduling optimization; routing, gating, auxiliary loss, and the EP
-sharding are the real thing.
+Switch-style top-1 routing with capacity-based token dispatch and a
+load-balancing auxiliary loss (Fedus et al., Switch Transformer; retrieved
+PAPERS.md pattern). Each token is routed to exactly one expert; every expert
+owns a fixed-size buffer of ``capacity = ceil(capacity_factor * tokens / E)``
+slots, so expert FLOPs scale with *tokens*, not ``tokens x E`` — tokens beyond
+an expert's capacity are dropped (their FFN contribution is zero, the residual
+stream still carries them), exactly the Switch semantics. Dispatch and combine
+are gathers over a statically-shaped slot table, which keeps everything
+jit-compatible (no ragged shapes) and lets GSPMD shard the expert einsums over
+the ``ep`` mesh axis (``param_pspecs``: the expert bank's leading axis lives on
+``ep``).
+
+The auxiliary loss is threaded *functionally* through the block stack (no
+mutable instance state), so concurrent traces of one model instance are safe.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -24,16 +31,21 @@ from .transformer import _TransformerBase, _dense, _layer_norm
 
 
 class _MoEMixin:
-    """Replaces the dense FFN with a routed expert bank on MoE layers."""
+    """Replaces the dense FFN with a capacity-routed expert bank on MoE layers."""
 
-    def _init_moe(self, num_experts: int, moe_every: int, aux_weight: float):
+    def _init_moe(self, num_experts: int, moe_every: int, aux_weight: float,
+                  capacity_factor: float = 1.25):
         self.num_experts = num_experts
         self.moe_every = max(1, moe_every)
         self.aux_weight = aux_weight
-        self._aux_losses = []
+        self.capacity_factor = capacity_factor
 
     def _is_moe_layer(self, i: int) -> bool:
         return (i % self.moe_every) == (self.moe_every - 1)
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, int(math.ceil(self.capacity_factor * n_tokens
+                                    / self.num_experts)))
 
     def _moe_block_specs(self):
         h, m, e = self.hidden, self.mlp_dim, self.num_experts
@@ -76,38 +88,77 @@ class _MoEMixin:
                 specs[f"block_{i}"] = self._moe_block_pspecs()
         return specs
 
-    def _moe_mlp(self, bp, x):
-        """x [B,S,H] -> routed expert FFN output + records the aux loss."""
+    def _moe_mlp(self, bp, x, token_mask=None):
+        """x [B,S,H] -> (routed expert FFN output [B,S,H], aux loss scalar).
+
+        Capacity-routed top-1 dispatch: each token claims the next free slot
+        in its expert's [C,H] buffer via a cumulative-count position; the slot
+        table is a static-shape scatter/gather, so per-token work is O(C*H*M)
+        per expert regardless of E. Slot buffers carry an extra "overflow" row
+        that dropped tokens read back as zeros. ``token_mask`` [B,S] excludes
+        padding tokens: they claim no capacity (identical all-zero pad rows
+        would otherwise flood one expert and evict real tokens) and don't
+        enter the load-balancing statistics.
+        """
         b, s, h = x.shape
         e = self.num_experts
-        router_logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32),
+        n = b * s
+        c = self._capacity(n)
+        xf = x.reshape(n, h)
+
+        router_logits = jnp.einsum("nh,he->ne", xf.astype(jnp.float32),
                                    bp["router"])
-        probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E]
-        expert_idx = jnp.argmax(probs, axis=-1)                 # [B,S]
+        probs = jax.nn.softmax(router_logits, axis=-1)           # [N,E]
+        expert_idx = jnp.argmax(probs, axis=-1)                  # [N]
+        gate = jnp.max(probs, axis=-1)                           # [N]
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
-        gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [B,S,1]
+        if token_mask is not None:
+            live = token_mask.reshape(n).astype(jnp.float32)
+            onehot = onehot * live[:, None]                      # dead rows: no slot
+        else:
+            live = None
 
-        # Switch load-balancing loss: E * sum_e fraction_tokens_e * mean_prob_e
-        frac = jnp.mean(onehot, axis=(0, 1))                    # [E]
-        mean_prob = jnp.mean(probs, axis=(0, 1))                # [E]
-        self._aux_losses.append(e * jnp.sum(frac * mean_prob))
+        # Switch load-balancing loss over live tokens:
+        # E * sum_e frac_tokens_e * mean_prob_e
+        denom = jnp.sum(live) if live is not None else float(n)
+        denom = jnp.maximum(denom, 1.0)
+        probs_live = probs * live[:, None] if live is not None else probs
+        aux = e * jnp.sum((jnp.sum(onehot, axis=0) / denom)
+                          * (jnp.sum(probs_live, axis=0) / denom))
 
-        # expert bank, leading axis sharded over 'ep': each device computes its
-        # expert over the full token stream; the e-contraction below becomes a
-        # psum over ep under GSPMD. Non-selected contributions are zeroed by
-        # the one-hot combine.
-        xc = x
-        hmid = jnp.einsum("bsh,ehm->ebsm", xc, bp["experts_fc1"].astype(xc.dtype))
-        hmid = jax.nn.gelu(hmid + bp["experts_b1"].astype(hmid.dtype)[:, None, None, :])
-        out = jnp.einsum("ebsm,emh->ebsh", hmid, bp["experts_fc2"].astype(hmid.dtype))
-        out = out + bp["experts_b2"].astype(out.dtype)[:, None, None, :]
-        combined = jnp.einsum("ebsh,bse->bsh", out,
-                              (onehot * gate).astype(out.dtype))
-        return combined
+        # position of each token within its expert's buffer, in token order
+        pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
+                      axis=-1).astype(jnp.int32)                 # [N]
+        kept = pos < c
+        if live is not None:
+            kept = kept & (live > 0)
+        # flat slot id; dropped tokens all point at the overflow slot e*c
+        slot = jnp.where(kept, expert_idx.astype(jnp.int32) * c + pos, e * c)
 
-    def _block(self, bp, x, mask, causal, train, rng):
+        # which token fills each slot; empty slots point at pad token index n
+        token_for_slot = jnp.full((e * c + 1,), n, dtype=jnp.int32)
+        token_for_slot = token_for_slot.at[slot].set(
+            jnp.arange(n, dtype=jnp.int32))[:e * c]
+        xf_pad = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)], axis=0)
+        xe = xf_pad[token_for_slot].reshape(e, c, h)             # [E,C,H]
+
+        # expert FFN over the slot buffers; leading axis sharded over 'ep'
+        hmid = jnp.einsum("ech,ehm->ecm", xe, bp["experts_fc1"].astype(xe.dtype))
+        hmid = jax.nn.gelu(hmid + bp["experts_b1"].astype(hmid.dtype)[:, None, :])
+        out = jnp.einsum("ecm,emh->ech", hmid, bp["experts_fc2"].astype(hmid.dtype))
+        out = out + bp["experts_b2"].astype(out.dtype)[:, None, :]
+
+        # combine: each token reads its slot back; overflow slot row is zero
+        out_pad = jnp.concatenate([out.reshape(e * c, h),
+                                   jnp.zeros((1, h), out.dtype)], axis=0)
+        y = out_pad[slot] * gate[:, None].astype(out.dtype)
+        return y.reshape(b, s, h).astype(x.dtype), aux
+
+    def _block_aux(self, bp, x, mask, causal, train, rng):
+        """Base ``_block_aux`` for dense blocks; routed FFN + router aux on
+        MoE blocks (the encoder loop lives in ``_TransformerBase._encode``)."""
         if "router" not in bp:
-            return super()._block(bp, x, mask, causal, train, rng)
+            return super()._block_aux(bp, x, mask, causal, train, rng)
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = _dense(y, bp["qkv_kernel"], bp["qkv_bias"])
@@ -118,17 +169,9 @@ class _MoEMixin:
         att, rng = self._dropout(_dense(att, bp["o_kernel"], bp["o_bias"]), train, rng)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-        y = self._moe_mlp(bp, y)
+        y, aux = self._moe_mlp(bp, y, token_mask=mask)
         y, rng = self._dropout(y, train, rng)
-        return x + y, rng
-
-    def _collect_aux(self) -> jnp.ndarray:
-        """Sum and clear aux losses recorded during the last forward."""
-        if not self._aux_losses:
-            return jnp.zeros(())
-        total = sum(self._aux_losses[1:], self._aux_losses[0])
-        self._aux_losses = []
-        return total * self.aux_weight
+        return x + y, rng, aux
 
 
 @register_model("transformer_moe_lm")
@@ -136,24 +179,30 @@ class MoETransformerLM(_MoEMixin, _TransformerBase):
     """Causal MoE LM: Switch FFN every ``moe_every``-th block, EP shardable."""
 
     def __init__(self, vocab_size: int, num_experts: int = 8, moe_every: int = 2,
-                 router_aux_weight: float = 0.01, **kw):
-        self._init_moe(num_experts, moe_every, router_aux_weight)
+                 router_aux_weight: float = 0.01,
+                 capacity_factor: float = 1.25, **kw):
+        self._init_moe(num_experts, moe_every, router_aux_weight,
+                       capacity_factor)
         super().__init__(vocab_size, **kw)
         self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
         self.graphdef = _Names(self.TENSORS)
 
-    def _forward(self, params, feeds, train, rng):
-        self._aux_losses = []
-        x, _ = self._encode(params, feeds, causal=True, train=train, rng=rng)
+    def _logits_aux(self, params, feeds, train, rng):
+        """Shared encode + tied-embedding projection for forward and loss."""
+        x, _, aux = self._encode(params, feeds, causal=True, train=train,
+                                 rng=rng)
         logits = jnp.matmul(x.astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
+        return logits, aux
+
+    def _forward(self, params, feeds, train, rng):
+        logits, _ = self._logits_aux(params, feeds, train, rng)
         return {"logits": logits,
                 "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
 
     def _loss(self, params, feeds, train, rng):
         ids = feeds["input_ids"].astype(jnp.int32)
-        logits = self._forward(params, feeds, train, rng)["logits"]
-        aux = self._collect_aux()
+        logits, aux = self._logits_aux(params, feeds, train, rng)
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         tgt = ids[:, 1:]
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
@@ -163,4 +212,4 @@ class MoETransformerLM(_MoEMixin, _TransformerBase):
         else:
             per = jnp.mean(nll, axis=-1)
         # aux spread per-example so the masked-mean trainer stays correct
-        return per + aux
+        return per + aux * self.aux_weight

@@ -142,7 +142,15 @@ class _TransformerBase(RegistryModel):
         y, rng = self._dropout(_dense(y, bp["fc2_kernel"], bp["fc2_bias"]), train, rng)
         return x + y, rng
 
+    def _block_aux(self, bp, x, mask, causal, train, rng):
+        """Block step that also returns an auxiliary-loss contribution (zero
+        for dense blocks; the MoE mixin overrides this with router aux)."""
+        x, rng = self._block(bp, x, mask, causal, train, rng)
+        return x, rng, jnp.zeros((), jnp.float32)
+
     def _encode(self, params, feeds, causal, train, rng):
+        """Returns ``(encoded, mask, aux)`` — aux is the summed per-block
+        auxiliary loss, threaded functionally (no mutable instance state)."""
         ids = feeds["input_ids"].astype(jnp.int32)
         mask = feeds.get("attention_mask")
         b, s = ids.shape
@@ -159,13 +167,15 @@ class _TransformerBase(RegistryModel):
         x = self.cast(x)
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        block = self._block
+        block = self._block_aux
         if self.remat:
-            block = jax.checkpoint(self._block, static_argnums=(3, 4))
+            block = jax.checkpoint(self._block_aux, static_argnums=(3, 4))
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.num_layers):
-            x, rng = block(params[f"block_{i}"], x, mask, causal, train, rng)
+            x, rng, aux = block(params[f"block_{i}"], x, mask, causal, train, rng)
+            aux_total = aux_total + aux
         return _layer_norm(x, params["final_ln"]["scale"],
-                           params["final_ln"]["bias"]), mask
+                           params["final_ln"]["bias"]), mask, aux_total
 
 
 @register_model("transformer_classifier")
@@ -196,7 +206,7 @@ class TransformerClassifier(_TransformerBase):
         return specs
 
     def _forward(self, params, feeds, train, rng):
-        x, mask = self._encode(params, feeds, causal=False, train=train, rng=rng)
+        x, mask, _ = self._encode(params, feeds, causal=False, train=train, rng=rng)
         if mask is not None:
             w = mask[:, :, None].astype(x.dtype)
             pooled = jnp.sum(x * w, axis=1) / jnp.maximum(jnp.sum(w, axis=1), 1e-6)
@@ -226,7 +236,7 @@ class TransformerLM(_TransformerBase):
         self.graphdef = _Names(self.TENSORS)
 
     def _forward(self, params, feeds, train, rng):
-        x, _ = self._encode(params, feeds, causal=True, train=train, rng=rng)
+        x, _, _ = self._encode(params, feeds, causal=True, train=train, rng=rng)
         logits = jnp.matmul(x.astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
         return {"logits": logits,

@@ -46,16 +46,27 @@ def load_library(verbose: bool = False) -> Optional[ctypes.CDLL]:
         _TRIED = True
         path = _lib_path()
         if not os.path.exists(path):
+            # compile to a process-unique temp path and rename into place:
+            # concurrent builders (e.g. several Spark executors on one host)
+            # must never dlopen a partially written .so
+            tmp = f"{path}.tmp.{os.getpid()}"
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                   _SRC, "-o", path]
+                   _SRC, "-o", tmp]
             try:
                 subprocess.run(cmd, check=True, capture_output=not verbose,
                                timeout=120)
+                os.replace(tmp, path)  # atomic on POSIX
             except Exception as e:  # toolchain missing/broken -> numpy fallback
                 if verbose:
                     print(f"sparkflow_tpu: native build failed ({e}); "
                           f"using numpy fallback", file=sys.stderr)
                 return None
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
         try:
             lib = ctypes.CDLL(path)
         except OSError:

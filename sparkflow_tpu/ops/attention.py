@@ -23,8 +23,10 @@ Layout convention: ``[batch, heads, seq, head_dim]``.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+from contextvars import ContextVar
 from typing import Optional
 
 import jax
@@ -38,6 +40,29 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+
+# Trace-time switch: pallas_call lowers to a custom call that GSPMD has no
+# partitioning rule for, so under a sharded jit (dp/tp over a >1-device mesh)
+# the kernel's operands may be sharded and the compiled program would
+# replicate them (all-gather) or fail outright. The sharded train-step
+# builders trace under force_xla_attention() so attention takes the blockwise
+# XLA path, which GSPMD partitions cleanly. Running the pallas kernel
+# per-shard inside shard_map is the eventual perf path on real multi-chip
+# meshes; single-device jit keeps the kernel.
+_FORCE_XLA: ContextVar[bool] = ContextVar("sparkflow_force_xla_attention",
+                                          default=False)
+
+
+@contextlib.contextmanager
+def force_xla_attention():
+    """Within this context (including jit *tracing* started inside it),
+    :func:`flash_attention` routes to the XLA blockwise/reference path instead
+    of the pallas kernel. See the note on ``_FORCE_XLA`` above."""
+    tok = _FORCE_XLA.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA.reset(tok)
 
 
 # ---------------------------------------------------------------------------
@@ -247,6 +272,11 @@ def flash_attention(q, k, v, causal: bool = False,
         interpret = not on_tpu
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
+    if _FORCE_XLA.get():
+        # sharded-jit context: GSPMD can partition the blockwise path but not
+        # the pallas custom call
+        return _blockwise_attention(q, k, v, kv_mask, causal, scale,
+                                    block_k=block_k)
     # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
     # (lanes); sequences must tile exactly (pad upstream otherwise)
     tiles_ok = (pltpu is not None

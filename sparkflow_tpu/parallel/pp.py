@@ -8,10 +8,19 @@ across them. Differentiation flows through the collective (ppermute transposes
 to the reverse permute), so this is a complete train step, not a forward-only
 demo.
 
-Round-1 schedule note: stages execute sequentially per microbatch (a device
-idles while another stage computes — the classic bubble). The 1F1B/GPipe
-overlapped schedule is a scheduling optimization on top of this same layout;
-the memory distribution, collectives, and numerics are already the real thing.
+Two schedules share the layout and numerics:
+
+- ``'gpipe'`` (default): the overlapped fill-drain schedule. Every tick, ALL
+  stages compute concurrently — stage ``s`` works on microbatch ``t - s`` —
+  so a step's serial span is ``M + P - 1`` stage-times instead of the
+  sequential ``M * P`` (utilization ``M/(M+P-1)``; Huang et al., GPipe).
+  Invalid (fill/drain) ticks compute on placeholder activations whose chains
+  never reach a live loss term, so masking them keeps gradients exact.
+  Autodiff reverses the schedule tick-by-tick (ppermute transposes to the
+  reverse ring), giving the overlapped backward for free; per-tick
+  ``jax.checkpoint`` keeps activation memory at stage boundaries.
+- ``'sequential'``: the round-1 schedule (one stage live per tick), kept as
+  the numerics cross-check baseline.
 """
 
 from __future__ import annotations
@@ -74,16 +83,23 @@ def pp_pspecs(pp_params):
 
 
 def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
-                       pp_axis: str = "pp"):
+                       pp_axis: str = "pp", schedule: str = "gpipe"):
     """Pipeline-parallel train step for the transformer classifier.
 
     Signature: ``step(pp_params, opt_state, ids, y, rng) ->
     (pp_params, opt_state, loss)`` — ids [B, S] replicated across pp (batch is
     the microbatch loop's dimension), params in :func:`split_stage_params`
-    layout sharded over 'pp'.
+    layout sharded over 'pp'. ``schedule`` is ``'gpipe'`` (overlapped,
+    ``M + P - 1`` serial stage-times) or ``'sequential'`` (``M * P``, the
+    numerics baseline). The returned callable exposes ``schedule_ticks``: the
+    number of serial stage-computations in its forward sweep.
     """
+    if schedule not in ("gpipe", "sequential"):
+        raise ValueError(f"unknown pp schedule {schedule!r}")
     n_stages = mesh.shape[pp_axis]
     per = model.num_layers // n_stages
+    M = n_microbatches
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def stage_apply(stage_blocks, x, rng):
         """Apply this device's ``per`` blocks (stacked leading axis)."""
@@ -95,6 +111,63 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
 
         (x, rng), _ = jax.lax.scan(body, (x, rng), stage_blocks)
         return x
+
+    from ..models.transformer import _dense, _layer_norm
+
+    def embed_micro(shared, ids, m_idx, mb):
+        """Embed microbatch ``m_idx`` (clamped: fill/drain ticks reuse a real
+        slice, their chains are masked out of the loss)."""
+        mi = jnp.clip(m_idx, 0, M - 1)
+        idsm = jax.lax.dynamic_slice_in_dim(ids, mi * mb, mb, axis=0)
+        x = jnp.take(shared["embed"]["tok"], idsm, axis=0)
+        x = x + shared["embed"]["pos"][:ids.shape[1]][None, :, :]
+        return model.cast(x)
+
+    def head_loss(shared, x, y, m_idx, mb):
+        """Mean loss of microbatch ``m_idx`` from final-stage activations."""
+        mi = jnp.clip(m_idx, 0, M - 1)
+        ym = jax.lax.dynamic_slice_in_dim(y, mi * mb, mb, axis=0)
+        x = _layer_norm(x, shared["final_ln"]["scale"], shared["final_ln"]["bias"])
+        pooled = jnp.mean(x, axis=1).astype(jnp.float32)
+        logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
+        return jnp.mean(-jnp.sum(ym * jax.nn.log_softmax(logits, axis=-1), axis=-1))
+
+    # ---- gpipe: every stage computes every tick, on microbatch (t - s) ----
+
+    def gpipe_loss(pp_params, ids, y, rng):
+        s = jax.lax.axis_index(pp_axis)
+        shared = pp_params["shared"]
+        my_blocks = jax.tree.map(lambda a: a[0], pp_params["stages"])
+        ids = ids.astype(jnp.int32)
+        b, seq = ids.shape
+        mb = b // M
+        T = M + n_stages - 1  # fill-drain span
+
+        ckpt_stage = jax.checkpoint(stage_apply)
+
+        def tick(carry, t):
+            x_in, loss_acc = carry
+            m_here = t - s  # logical microbatch this stage holds at tick t
+            # stage 0 ingests a fresh microbatch; later stages use the ring
+            inj = embed_micro(shared, ids, t, mb)
+            inp = jnp.where(s == 0, inj, x_in)
+            out = ckpt_stage(my_blocks, inp,
+                             jax.random.fold_in(rng, t * n_stages + s))
+            # the final stage finishes microbatch m_here this tick
+            lval = head_loss(shared, out, y, m_here, mb)
+            live = (s == n_stages - 1) & (m_here >= 0) & (m_here < M)
+            loss_acc = loss_acc + jnp.where(live, lval, 0.0)
+            x_next = jax.lax.ppermute(out, pp_axis, ring)
+            return (x_next, loss_acc), None
+
+        x0 = jnp.zeros((mb, seq, model.hidden),
+                       model.compute_dtype or jnp.float32)
+        (_, loss_acc), _ = jax.lax.scan(tick, (x0, jnp.zeros(())),
+                                        jnp.arange(T))
+        # every stage's partial losses (only the last stage has any) summed
+        return jax.lax.psum(loss_acc, pp_axis) / M
+
+    # ---- sequential: one stage live per tick (round-1 baseline) -----------
 
     def forward_one(pp_params, ids, y, rng):
         s = jax.lax.axis_index(pp_axis)
@@ -111,12 +184,10 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             def run(x):
                 return stage_apply(my_blocks, x, jax.random.fold_in(rng, t))
             x = jax.lax.cond(s == t, run, lambda x: x, x)
-            return jax.lax.ppermute(
-                x, pp_axis, [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return jax.lax.ppermute(x, pp_axis, ring)
 
         x = jax.lax.fori_loop(0, n_stages, tick, x)
         # after n_stages ticks the fully-processed activation is back on stage 0
-        from ..models.transformer import _dense, _layer_norm
         x = _layer_norm(x, shared["final_ln"]["scale"], shared["final_ln"]["bias"])
         pooled = jnp.mean(x, axis=1).astype(jnp.float32)
         logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
@@ -132,29 +203,34 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
              out_specs=(param_specs, P()),
              check_vma=False)
     def grad_fn(pp_params, ids, y, rng):
-        if ids.shape[0] % n_microbatches or ids.shape[0] < n_microbatches:
+        if ids.shape[0] % M or ids.shape[0] < M:
             raise ValueError(
                 f"batch {ids.shape[0]} must be a positive multiple of "
-                f"n_microbatches={n_microbatches}")
-        mb = ids.shape[0] // n_microbatches
+                f"n_microbatches={M}")
+        if schedule == "gpipe":
+            loss, grads = jax.value_and_grad(gpipe_loss, argnums=0)(
+                pp_params, ids, y, rng)
+        else:
+            # per-microbatch value_and_grad accumulation: only one
+            # microbatch's activations are ever live during backward
+            mb = ids.shape[0] // M
 
-        def micro(i, carry):
-            grads_acc, loss_acc = carry
-            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * mb, mb, axis=0)
-            loss, g = jax.value_and_grad(forward_one)(
-                pp_params, sl(ids), sl(y), jax.random.fold_in(rng, i))
-            grads_acc = jax.tree.map(jnp.add, grads_acc, g)
-            return grads_acc, loss_acc + loss
+            def micro(i, carry):
+                grads_acc, loss_acc = carry
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * mb, mb, 0)
+                l, g = jax.value_and_grad(forward_one)(
+                    pp_params, sl(ids), sl(y), jax.random.fold_in(rng, i))
+                return jax.tree.map(jnp.add, grads_acc, g), loss_acc + l
 
-        zero = jax.tree.map(jnp.zeros_like, pp_params)
-        grads, loss = jax.lax.fori_loop(0, n_microbatches, micro,
-                                        (zero, jnp.zeros(())))
-        grads = jax.tree.map(lambda x: x / n_microbatches, grads)
+            zero = jax.tree.map(jnp.zeros_like, pp_params)
+            grads, loss = jax.lax.fori_loop(0, M, micro, (zero, jnp.zeros(())))
+            grads = jax.tree.map(lambda x: x / M, grads)
+            loss = loss / M
         # shared params got gradient contributions on every stage: reduce;
         # stage params are exclusively local (their grads are already correct)
         grads["shared"] = jax.tree.map(
             lambda gg: jax.lax.psum(gg, pp_axis), grads["shared"])
-        return grads, loss / n_microbatches
+        return grads, loss
 
     def step(pp_params, opt_state, ids, y, rng):
         grads, loss = grad_fn(pp_params, ids, y, rng)
@@ -164,4 +240,8 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+    # serial forward span in stage-times: the schedule's defining number
+    jitted.schedule_ticks = (M + n_stages - 1 if schedule == "gpipe"
+                             else M * n_stages)
+    return jitted

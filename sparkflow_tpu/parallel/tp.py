@@ -71,7 +71,8 @@ def make_sharded_train_step(model, optimizer, mesh: Mesh, input_name: str,
         params, opt_state, loss = step(params, opt_state, x, y, mask, rng)
     """
     loss_fn = make_loss_fn(model, input_name, label_name)
-    step = _step_body(loss_fn, optimizer)
+    from ..core import _sharded_trace_guard
+    step = _sharded_trace_guard(_step_body(loss_fn, optimizer), mesh)
     data = NamedSharding(mesh, P(dp_axis))
     repl = NamedSharding(mesh, P())
     return jax.jit(step,

@@ -138,6 +138,13 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     weightsPath = Param(Params._dummy(), "weightsPath", "", typeConverter=TypeConverters.toString)
     checkpointDir = Param(Params._dummy(), "checkpointDir", "", typeConverter=TypeConverters.toString)
     checkpointEvery = Param(Params._dummy(), "checkpointEvery", "", typeConverter=TypeConverters.toInt)
+    # fitMode: 'collect' (reference behavior, tensorflow_async.py:290-293 —
+    # materialize the RDD on the driver) or 'stream' (rdd.toLocalIterator into
+    # Trainer.fit_stream: the dataset is consumed one partition at a time and
+    # never fully materializes on the driver — SURVEY.md hard-part #1). In
+    # stream mode the `partitions` Param is the streaming granularity: one
+    # partition is the most data resident on the driver at once.
+    fitMode = Param(Params._dummy(), "fitMode", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -164,7 +171,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  port=None,
                  weightsPath=None,
                  checkpointDir=None,
-                 checkpointEvery=None):
+                 checkpointEvery=None,
+                 fitMode=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -179,7 +187,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          verbose=0, iters=1000, toKeepDropout=False,
                          predictionCol='predicted', labelCol=None,
                          partitionShuffles=1, optimizerOptions=None, port=5000,
-                         weightsPath=None, checkpointDir=None, checkpointEvery=0)
+                         weightsPath=None, checkpointDir=None, checkpointEvery=0,
+                         fitMode='collect')
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -209,7 +218,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   port=None,
                   weightsPath=None,
                   checkpointDir=None,
-                  checkpointEvery=None):
+                  checkpointEvery=None,
+                  fitMode=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -276,22 +286,48 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     def getPort(self):
         return self.getOrDefault(self.port)
 
+    def getFitMode(self):
+        return self.getOrDefault(self.fitMode)
+
+    def _validate_params(self):
+        """Error loudly on inconsistent Param combinations — the reference
+        fails fast when a supervised graph gets no label
+        (``tensorflow_async.py:290`` KeyErrors on the missing column); silently
+        training a supervised loss against dummy zeros is worse."""
+        label_col = self.getOrDefault(self.labelCol)
+        tf_label = self.getTfLabel()
+        if tf_label is not None and label_col is None:
+            raise ValueError(
+                "tfLabel=%r names a label tensor but labelCol is None: the "
+                "supervised loss would train on dummy zero labels. Set "
+                "labelCol (or clear tfLabel for unsupervised training)."
+                % tf_label)
+        if label_col is not None and tf_label is None:
+            raise ValueError(
+                "labelCol=%r supplies labels but tfLabel is None, so no loss "
+                "consumes them. Set tfLabel (or clear labelCol)." % label_col)
+        fit_mode = (self.getFitMode() or "collect").lower()
+        if fit_mode not in ("collect", "stream"):
+            raise ValueError("fitMode must be 'collect' or 'stream', got %r"
+                             % self.getFitMode())
+        return fit_mode
+
     def _fit(self, dataset):
         inp_col = self.getOrDefault(self.inputCol)
         graph_json = self.getTensorflowGraph()
         label_col = self.getOrDefault(self.labelCol)
         tf_label = self.getTfLabel()
         optimizer_options = self.getOptimizerOptions()
+        fit_mode = self._validate_params()
 
         # DataFrame -> (features, label) pairs; partitions Param shapes the RDD
-        # exactly as the reference does (tensorflow_async.py:290-291), then the
-        # union of partition data is staged onto the device mesh.
+        # exactly as the reference does (tensorflow_async.py:290-291). In
+        # collect mode the union of partition data is staged onto the device
+        # mesh; in stream mode partitions are consumed one at a time.
         rdd = dataset.rdd.map(lambda r: handle_data(r, inp_col, label_col))
         partitions = self.getPartitions()
         if rdd.getNumPartitions() > partitions:
             rdd = rdd.coalesce(partitions)
-        items = rdd.collect()
-        features, labels = handle_features(items, is_supervised=label_col is not None)
 
         optimizer = build_optimizer_from_json(self.getTfOptimizer(),
                                               self.getTfLearningRate(),
@@ -314,7 +350,28 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             checkpoint_dir=self.getOrDefault(self.checkpointDir),
             checkpoint_every=self.getOrDefault(self.checkpointEvery) or 0,
         )
-        result = trainer.fit(features, labels)
+        if fit_mode == "stream":
+            # one epoch = one pass over rdd.toLocalIterator(): the dataset
+            # never fully materializes on the driver (bounded by one
+            # partition + the batch-assembly ring). Epoch count matches the
+            # collect path (iters x partitionShuffles passes); optimizer
+            # state and the rng stream persist across passes inside
+            # fit_stream, exactly like epochs over an in-memory dataset.
+            epochs = max(1, self.getIters()) * max(1, self.getPartitionShuffles())
+            # executor-side persist: without it every epoch would re-execute
+            # the full RDD lineage (driver memory stays bounded either way)
+            if hasattr(rdd, "persist"):
+                rdd.persist()
+            try:
+                result = trainer.fit_stream(rdd.toLocalIterator, epochs=epochs)
+            finally:
+                if hasattr(rdd, "unpersist"):
+                    rdd.unpersist()
+        else:
+            items = rdd.collect()
+            features, labels = handle_features(
+                items, is_supervised=label_col is not None)
+            result = trainer.fit(features, labels)
         weights_path = self.getOrDefault(self.weightsPath)
         if weights_path:
             if not weights_path.endswith(".npz"):

@@ -90,6 +90,9 @@ class Trainer:
                  compute_dtype=None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0,
+                 resume_retries: int = 2,
+                 straggler_factor: Optional[float] = None,
+                 straggler_callback: Optional[Callable] = None,
                  metrics=None):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
@@ -127,6 +130,16 @@ class Trainer:
         # reference's save-at-end-only persistence (SURVEY.md §5)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        # pod-scale failure handling (SURVEY.md §5: the reference's
+        # drop-the-update-and-print "is not acceptable at pod scale"):
+        # with a checkpoint_dir configured, a failing epoch auto-restores the
+        # last checkpoint and continues, up to resume_retries times.
+        # straggler_factor (e.g. 3.0) opts into per-epoch heartbeat timing:
+        # an epoch slower than factor x the running median logs a warning,
+        # emits a metric, and calls straggler_callback(epoch, secs, median).
+        self.resume_retries = resume_retries
+        self.straggler_factor = straggler_factor
+        self.straggler_callback = straggler_callback
         if metrics is None:
             from .utils.metrics import default_metrics
             metrics = default_metrics
@@ -197,13 +210,17 @@ class Trainer:
 
         ckpt_mgr = None
         start_epoch = 0
+        ckpt_like = None
         if self.checkpoint_dir:
             from .checkpoint import CheckpointManager
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
-            state = ckpt_mgr.restore(like={"params": params,
-                                           "opt_state": opt_state,
-                                           "epoch": np.int64(0),
-                                           "rng": np.asarray(rng)})
+            # host-side structural template, captured BEFORE any donation can
+            # invalidate device buffers (restore-after-failure needs it)
+            ckpt_like = jax.tree.map(np.asarray,
+                                     {"params": params, "opt_state": opt_state,
+                                      "epoch": np.int64(0),
+                                      "rng": np.asarray(rng)})
+            state = ckpt_mgr.restore(like=ckpt_like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
@@ -211,73 +228,135 @@ class Trainer:
                 rng = jnp.asarray(state["rng"])
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
 
-        cache_key = (batch, num_batches, mode, self.shuffle_per_iter)
+        cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
+                     n if mode == "stochastic" else None)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
             self._epoch_cache[cache_key] = make_epoch_fn(
                 loss_fn, self.optimizer, batch, num_batches, mode,
-                self.shuffle_per_iter, self.mesh)
+                self.shuffle_per_iter, self.mesh, n_real=n)
         epoch_fn = self._epoch_cache[cache_key]
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
         device_args = (jnp.asarray(x_pad), jnp.asarray(y_pad), jnp.asarray(mask))
 
-        loss_handles = []  # device scalars; converted lazily to keep async dispatch
+        loss_by_it = {}  # device scalars; converted lazily to keep async dispatch
         t0 = time.perf_counter()
         it = 0
+        ran = 0
         total_epochs = self.partition_shuffles * self.iters
-        for _round in range(self.partition_shuffles):
-            for _epoch in range(self.iters):
-                it += 1
-                if it <= start_epoch:
-                    # the restored rng was saved AFTER these epochs' splits —
-                    # skip without touching it so the stream continues exactly
-                    # where the interrupted run left off
-                    continue
-                rng, erng = jax.random.split(rng)
-                params, opt_state, losses = epoch_fn(params, opt_state,
-                                                     *device_args, erng)
-                loss_handles.append(jnp.mean(losses))
-                if self.verbose or self.loss_callback is not None:
-                    loss_val = float(loss_handles[-1])  # forces a device sync
-                    if self.verbose:
-                        logger.info("iteration %d loss %f", it, loss_val)
-                    self.metrics.scalar("train/loss", loss_val, step=it)
-                    if self.loss_callback is not None:
-                        # reference signature: loss_callback(loss, iteration,
-                        # partition_id) — HogwildSparkModel.py:99-100; there is
-                        # one logical partition here.
-                        self.loss_callback(loss_val, it, 0)
-                if (ckpt_mgr is not None and self.checkpoint_every > 0
-                        and (it % self.checkpoint_every == 0 or it == total_epochs)):
-                    ckpt_mgr.save(it, {"params": params, "opt_state": opt_state,
-                                       "epoch": np.int64(it),
-                                       "rng": np.asarray(rng)})
+        retries_left = self.resume_retries if ckpt_mgr is not None else 0
+        epoch_secs = []  # straggler heartbeat history (opt-in)
+        while True:
+            try:
+                it = 0
+                for _round in range(self.partition_shuffles):
+                    for _epoch in range(self.iters):
+                        it += 1
+                        if it <= start_epoch:
+                            # the restored rng was saved AFTER these epochs'
+                            # splits — skip without touching it so the stream
+                            # continues exactly where the interrupted run
+                            # left off
+                            continue
+                        te = time.perf_counter()
+                        rng, erng = jax.random.split(rng)
+                        params, opt_state, losses = epoch_fn(params, opt_state,
+                                                             *device_args, erng)
+                        loss_by_it[it] = jnp.mean(losses)
+                        ran += 1
+                        if self.verbose or self.loss_callback is not None:
+                            loss_val = float(loss_by_it[it])  # device sync
+                            if self.verbose:
+                                logger.info("iteration %d loss %f", it, loss_val)
+                            self.metrics.scalar("train/loss", loss_val, step=it)
+                            if self.loss_callback is not None:
+                                # reference signature: loss_callback(loss,
+                                # iteration, partition_id) —
+                                # HogwildSparkModel.py:99-100; one logical
+                                # partition here.
+                                self.loss_callback(loss_val, it, 0)
+                        if self.straggler_factor:
+                            jax.block_until_ready(loss_by_it[it])
+                            secs = time.perf_counter() - te
+                            if len(epoch_secs) >= 3:
+                                med = float(np.median(epoch_secs))
+                                if secs > self.straggler_factor * med:
+                                    logger.warning(
+                                        "straggling epoch %d: %.3fs vs "
+                                        "median %.3fs", it, secs, med)
+                                    self.metrics.scalar("train/straggler_secs",
+                                                        secs, step=it)
+                                    if self.straggler_callback is not None:
+                                        self.straggler_callback(it, secs, med)
+                            epoch_secs.append(secs)
+                        if (ckpt_mgr is not None and self.checkpoint_every > 0
+                                and (it % self.checkpoint_every == 0
+                                     or it == total_epochs)):
+                            ckpt_mgr.save(it, {"params": params,
+                                               "opt_state": opt_state,
+                                               "epoch": np.int64(it),
+                                               "rng": np.asarray(rng)})
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # pod-scale failure handling: restore the last checkpoint and
+                # keep training (the reference dropped the update and printed,
+                # HogwildSparkModel.py:68-92 — unacceptable per SURVEY.md §5)
+                state = (ckpt_mgr.restore(like=ckpt_like)
+                         if retries_left > 0 else None)
+                if state is None:
+                    raise
+                retries_left -= 1
+                params = jax.tree.map(jnp.asarray, state["params"])
+                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                start_epoch = int(state["epoch"])
+                rng = jnp.asarray(state["rng"])
+                # epochs past the restore point will re-run: drop their losses
+                loss_by_it = {k: v for k, v in loss_by_it.items()
+                              if k <= start_epoch}
+                logger.warning(
+                    "training failure at iteration %d (%s: %s); auto-resumed "
+                    "from checkpoint epoch %d (%d retries left)", it,
+                    type(e).__name__, e, start_epoch, retries_left)
         # block until the last step is done for honest timing
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         # real examples per epoch: padded rows carry zero weight and don't
         # count; stochastic mode counts sampled slots (its actual step volume)
         per_epoch = num_batches * batch if mode == "stochastic" else n
-        seen = per_epoch * max(it - start_epoch, 0)
+        seen = per_epoch * ran
         self.params = params
-        epoch_losses = [float(l) for l in loss_handles]
+        epoch_losses = [float(loss_by_it[k]) for k in sorted(loss_by_it)]
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
 
     def fit_stream(self, row_iterator, init_params=None, queue_capacity: int = 8,
-                   chunk: int = 1024) -> TrainResult:
+                   chunk: int = 1024, epochs: int = 1) -> TrainResult:
         """Streaming fit for datasets that don't fit in device memory.
 
         ``row_iterator`` yields ``(features, label)`` pairs (bare features when
-        unsupervised). A native C++ batch-assembly thread (numpy fallback)
-        pads/masks/shuffles fixed-shape batches concurrently with device
-        compute; each batch is one synchronous optimizer step. ``iters`` and
-        ``partition_shuffles`` are single-pass here: epochs over a stream
-        require the caller to re-supply the iterator (matching Spark's
-        rdd.toLocalIterator semantics).
+        unsupervised), or is a zero-arg callable returning a fresh such
+        iterator (required when ``epochs > 1`` — streams are single-pass, so
+        each epoch re-pulls the source, matching Spark's ``rdd.toLocalIterator``
+        semantics). Optimizer state, the rng stream, and the loss history
+        carry across epochs — multiple epochs here train identically to
+        repeated passes over an in-memory dataset, not like restarted fits.
+
+        A native C++ batch-assembly thread (numpy fallback) pads/masks/
+        shuffles fixed-shape batches concurrently with device compute; each
+        batch is one synchronous optimizer step.
         """
+        import itertools as _it
+
         from .core import make_train_step
+        from .localml.linalg import vector_to_array
         from .utils.data import BatchQueue, feed_from_iterator
+
+        factory = row_iterator if callable(row_iterator) else None
+        if epochs > 1 and factory is None:
+            raise ValueError("epochs > 1 needs a callable iterator factory "
+                             "(streams are single-pass)")
 
         supervised = self.label_name is not None
         rng = jax.random.PRNGKey(self.seed)
@@ -286,26 +365,8 @@ class Trainer:
         bs = self.mini_batch_size if self.mini_batch_size and self.mini_batch_size > 0 else 128
         bs = -(-bs // self._dp_size()) * self._dp_size()
 
-        it = iter(row_iterator)
-        try:
-            first = next(it)
-        except StopIteration:
-            raise ValueError("no training data")
-        import itertools as _it
-        from .localml.linalg import vector_to_array
-        feat0 = vector_to_array(first[0] if supervised else first)
-        row_dim = int(feat0.shape[0])
-        if supervised:
-            lbl0 = first[1]
-            label_dim = 1 if isinstance(lbl0, (int, float)) else len(vector_to_array(lbl0))
-        else:
-            label_dim = 0
-
-        q = BatchQueue(bs, row_dim, label_dim, capacity=queue_capacity,
-                       shuffle=self.shuffle_per_iter, seed=self.seed)
-        feeder = feed_from_iterator(q, _it.chain([first], it), supervised, chunk)
-
         if init_params is not None:
+            # copy: the train step donates its params buffers
             params = jax.tree.map(lambda a: jnp.array(a), init_params)
         else:
             params = self.model.init(init_rng)
@@ -313,26 +374,77 @@ class Trainer:
         loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
         step = make_train_step(loss_fn, self.optimizer, self.mesh)
 
+        ckpt_mgr = None
+        start_step = 0
+        if self.checkpoint_dir:
+            # streaming checkpoint/resume: state is saved every
+            # checkpoint_every STEPS; a restart restores weights + optimizer
+            # state and continues on the incoming stream (streams can't
+            # rewind, so previously consumed rows are not replayed)
+            from .checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(self.checkpoint_dir)
+            like = jax.tree.map(np.asarray,
+                                {"params": params, "opt_state": opt_state,
+                                 "epoch": np.int64(0),
+                                 "rng": np.asarray(rng)})
+            state = ckpt_mgr.restore(like=like)
+            if state is not None:
+                params = jax.tree.map(jnp.asarray, state["params"])
+                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                start_step = int(state["epoch"])
+                rng = jnp.asarray(state["rng"])
+                logger.info("fit_stream resumed weights from step %d",
+                            start_step)
+
         losses = []
         seen = 0
+        it_count = start_step
         t0 = time.perf_counter()
         dummy_y = np.zeros((bs, 1), np.float32)
-        try:
-            for x, y, mask, n_real in q:
-                rng, srng = jax.random.split(rng)
-                params, opt_state, loss = step(params, opt_state, x,
-                                               y if supervised else dummy_y,
-                                               mask, srng)
-                losses.append(loss)
-                seen += n_real
-                if self.loss_callback is not None:
-                    self.loss_callback(float(loss), len(losses), 0)
-            feeder.join()
-        finally:
-            # always tear the queue down (drains and unblocks the feeder);
-            # without this a failing step would leak the native ring and leave
-            # the producer thread blocked forever
-            q.close()
+        for epoch in range(max(1, epochs)):
+            it = iter(factory() if factory else row_iterator)
+            try:
+                first = next(it)
+            except StopIteration:
+                raise ValueError("no training data")
+            feat0 = vector_to_array(first[0] if supervised else first)
+            row_dim = int(feat0.shape[0])
+            if supervised:
+                lbl0 = first[1]
+                label_dim = (1 if isinstance(lbl0, (int, float))
+                             else len(vector_to_array(lbl0)))
+            else:
+                label_dim = 0
+
+            q = BatchQueue(bs, row_dim, label_dim, capacity=queue_capacity,
+                           shuffle=self.shuffle_per_iter,
+                           seed=self.seed + epoch)
+            feeder = feed_from_iterator(q, _it.chain([first], it), supervised,
+                                        chunk)
+            try:
+                for x, y, mask, n_real in q:
+                    rng, srng = jax.random.split(rng)
+                    params, opt_state, loss = step(params, opt_state, x,
+                                                   y if supervised else dummy_y,
+                                                   mask, srng)
+                    losses.append(loss)
+                    seen += n_real
+                    it_count += 1
+                    if self.loss_callback is not None:
+                        self.loss_callback(float(loss), it_count, 0)
+                    if (ckpt_mgr is not None and self.checkpoint_every > 0
+                            and it_count % self.checkpoint_every == 0):
+                        ckpt_mgr.save(it_count,
+                                      {"params": params,
+                                       "opt_state": opt_state,
+                                       "epoch": np.int64(it_count),
+                                       "rng": np.asarray(rng)})
+                feeder.join()
+            finally:
+                # always tear the queue down (drains and unblocks the feeder);
+                # without this a failing step would leak the native ring and
+                # leave the producer thread blocked forever
+                q.close()
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         self.params = params

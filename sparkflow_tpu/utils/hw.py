@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 
 
 def tpu_alive(timeout_s: int = 120) -> bool:
@@ -26,9 +27,13 @@ def tpu_alive(timeout_s: int = 120) -> bool:
         return False
 
 
-def ensure_live_backend(timeout_s: int = 120) -> bool:
+def ensure_live_backend(timeout_s: int = 120, retries: int = 1,
+                        backoff_s: float = 0.0) -> bool:
     """Probe the default backend; on failure force CPU. Returns True when a
     fallback happened.
+
+    ``retries`` probe attempts are made with ``backoff_s`` sleep between them
+    so a transient relay hiccup doesn't demote a benchmark run to CPU.
 
     Must run before any jax *device use* in this process (importing jax is
     fine — backends initialize on first device access, and the config update
@@ -36,8 +41,13 @@ def ensure_live_backend(timeout_s: int = 120) -> bool:
     letting the caller hang on a wedged accelerator init.
     """
     explicit_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
-    if explicit_cpu or tpu_alive(timeout_s):
+    if explicit_cpu:
         return False
+    for attempt in range(max(1, retries)):
+        if attempt and backoff_s:
+            time.sleep(backoff_s)
+        if tpu_alive(timeout_s):
+            return False
     os.environ["JAX_PLATFORMS"] = "cpu"  # covers child processes
     import jax  # first import in this process
 

@@ -1,0 +1,163 @@
+"""Pre-trained model import: npz side-files, TF1 Saver checkpoints.
+
+TF1 import mirrors the reference capability
+(``/root/reference/sparkflow/tensorflow_model_loader.py:8-32``): a Saver
+checkpoint's trainable variables become a served model's weights. Here the
+graph must be re-expressed in the nn DSL (TF1 protobufs don't execute on this
+framework) and weights are read straight off the checkpoint shards.
+"""
+
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.model_loader import (extract_tensorflow_weights,
+                                        load_checkpoint_model,
+                                        load_tensorflow_model,
+                                        save_weights_npz)
+
+
+def mlp_graph():
+    x = nn.placeholder([None, 4], name="x")
+    h = nn.dense(x, 3, activation="relu")
+    out = nn.dense(h, 2, name="out")
+    nn.mean_squared_error(x, out)  # loss unused for serving
+
+
+def _ref_weights(seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(4, 3).astype(np.float32), rs.randn(3).astype(np.float32),
+            rs.randn(3, 2).astype(np.float32), rs.randn(2).astype(np.float32)]
+
+
+def _manual_forward(w, x):
+    h = np.maximum(x @ w[0] + w[1], 0.0)
+    return h @ w[2] + w[3]
+
+
+def test_npz_checkpoint_model_roundtrip(tmp_path):
+    w = _ref_weights()
+    p = str(tmp_path / "w.npz")
+    save_weights_npz(p, w)
+    model = load_checkpoint_model(p, build_graph(mlp_graph), "features",
+                                  "x:0", "out/BiasAdd:0")
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    spark = LocalSession.builder.getOrCreate()
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in x], ["features"])
+    preds = np.stack([np.asarray(r["predicted"].toArray())
+                      for r in model.transform(df).collect()])
+    np.testing.assert_allclose(preds, _manual_forward(w, x), rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def tf1_checkpoint(tmp_path_factory):
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    w = _ref_weights(seed=7)
+    g = tf1.Graph()
+    d = tmp_path_factory.mktemp("tfckpt")
+    prefix = str(d / "to_load")
+    with g.as_default(), tf1.Session(graph=g) as sess:
+        # TF1-layer naming convention: dense/kernel, dense/bias, dense_1/...
+        with tf1.variable_scope("dense"):
+            tf1.get_variable("kernel", initializer=w[0])
+            tf1.get_variable("bias", initializer=w[1])
+        with tf1.variable_scope("dense_1"):
+            tf1.get_variable("kernel", initializer=w[2])
+            tf1.get_variable("bias", initializer=w[3])
+        # an optimizer slot variable that must NOT be imported
+        with tf1.variable_scope("dense/kernel"):
+            tf1.get_variable("Adam", initializer=np.zeros((4, 3), np.float32))
+        sess.run(tf1.global_variables_initializer())
+        tf1.train.Saver().save(sess, prefix)
+    return prefix, w
+
+
+def test_extract_tf_weights_order_and_slot_filtering(tf1_checkpoint):
+    prefix, w = tf1_checkpoint
+    got = extract_tensorflow_weights(prefix)
+    assert len(got) == 4  # Adam slot excluded
+    for a, b in zip(got, w):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_load_tensorflow_model_serves_checkpoint_weights(tf1_checkpoint):
+    prefix, w = tf1_checkpoint
+    model = load_tensorflow_model(prefix, "features", "x:0", "out/BiasAdd:0",
+                                  graph_json=build_graph(mlp_graph))
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    spark = LocalSession.builder.getOrCreate()
+    x = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in x], ["features"])
+    preds = np.stack([np.asarray(r["predicted"].toArray())
+                      for r in model.transform(df).collect()])
+    np.testing.assert_allclose(preds, _manual_forward(w, x), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_load_tensorflow_model_requires_graph(tf1_checkpoint):
+    prefix, _ = tf1_checkpoint
+    with pytest.raises(ValueError, match="graph_json is required"):
+        load_tensorflow_model(prefix, "features", "x:0", "out:0")
+
+
+def test_load_tensorflow_model_shape_mismatch_message(tf1_checkpoint):
+    prefix, _ = tf1_checkpoint
+
+    def wrong_graph():
+        x = nn.placeholder([None, 9], name="x")
+        out = nn.dense(x, 2, name="out")
+        nn.mean_squared_error(x, out)
+
+    with pytest.raises(ValueError, match="var_order"):
+        load_tensorflow_model(prefix, "features", "x:0", "out/BiasAdd:0",
+                              graph_json=build_graph(wrong_graph))
+
+
+def test_explicit_var_order(tf1_checkpoint):
+    prefix, w = tf1_checkpoint
+    got = extract_tensorflow_weights(
+        prefix, var_order=["dense_1/kernel", "dense_1/bias"])
+    np.testing.assert_array_equal(got[0], w[2])
+    np.testing.assert_array_equal(got[1], w[3])
+    with pytest.raises(KeyError):
+        extract_tensorflow_weights(prefix, var_order=["nope/kernel"])
+
+
+def test_shape_matching_survives_nonalphabetical_scopes(tmp_path):
+    """Hand-named scopes that sort against creation order must still land in
+    the right graph slots (shape-driven assignment)."""
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    rs = np.random.RandomState(3)
+    w = [rs.randn(4, 3).astype(np.float32), rs.randn(3).astype(np.float32),
+         rs.randn(3, 2).astype(np.float32), rs.randn(2).astype(np.float32)]
+    g = tf1.Graph()
+    prefix = str(tmp_path / "named")
+    with g.as_default(), tf1.Session(graph=g) as sess:
+        # creation order: zebra (layer 1) then alpha (layer 2) — alphabetical
+        # sorting would swap them; shapes differ, so matching fixes it
+        with tf1.variable_scope("zebra"):
+            tf1.get_variable("kernel", initializer=w[0])
+            tf1.get_variable("bias", initializer=w[1])
+        with tf1.variable_scope("alpha"):
+            tf1.get_variable("kernel", initializer=w[2])
+            tf1.get_variable("bias", initializer=w[3])
+        sess.run(tf1.global_variables_initializer())
+        tf1.train.Saver().save(sess, prefix)
+
+    model = load_tensorflow_model(prefix, "features", "x:0", "out/BiasAdd:0",
+                                  graph_json=build_graph(mlp_graph))
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    spark = LocalSession.builder.getOrCreate()
+    x = np.random.RandomState(4).randn(5, 4).astype(np.float32)
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in x], ["features"])
+    preds = np.stack([np.asarray(r["predicted"].toArray())
+                      for r in model.transform(df).collect()])
+    np.testing.assert_allclose(preds, _manual_forward(w, x), rtol=1e-5,
+                               atol=1e-5)

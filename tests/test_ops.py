@@ -110,3 +110,28 @@ def test_ring_attention_causal(dp_mesh):
     out = jax.jit(ring)(q, q, q)
     ref = attention_reference(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_force_xla_attention_skips_pallas(monkeypatch):
+    """Sharded-jit programs must not hit the pallas kernel (no GSPMD
+    partitioning rule); the guard context routes to the blockwise path."""
+    import jax.numpy as jnp
+    import pytest
+    from sparkflow_tpu.ops import attention as A
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 128, 8), jnp.float32)
+
+    def boom(*a, **k):
+        raise RuntimeError("pallas path taken")
+
+    monkeypatch.setattr(A, "_flash", boom)
+    # tiling-eligible shape: without the guard the kernel is attempted...
+    with pytest.raises(RuntimeError, match="pallas path taken"):
+        A.flash_attention(q, q, q)
+    # ...and inside the guard context the XLA blockwise path runs instead
+    with A.force_xla_attention():
+        out = A.flash_attention(q, q, q)
+    ref = A.attention_reference(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
