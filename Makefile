@@ -1,13 +1,19 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast bench baseline examples native clean
+.PHONY: test test-fast test-pyspark bench baseline examples native clean
 
 test:
 	python -m pytest tests/ -q
 
 test-fast:
 	python -m pytest tests/ -x -q -k "not estimator"
+
+# real-pyspark e2e: installs pyspark (JVM required) and runs the mirrored
+# reference suite on local[2], incl. the StopWordsRemover persistence carrier
+test-pyspark:
+	pip install "pyspark>=3.4"
+	python -m pytest tests/test_pyspark_e2e.py -v
 
 bench:
 	python bench.py

@@ -163,6 +163,26 @@ def bench_flash_attention():
     _emit("flash_attention_vs_xla", tr / tf, "speedup_x",
           {"seq": S, "flash_ms": round(tf * 1e3, 2), "xla_ms": round(tr * 1e3, 2)})
 
+    # fwd+bwd: the training-path comparison (pallas dq/dk/dv kernels vs
+    # XLA autodiff of the dense reference)
+    fg = jax.jit(jax.grad(lambda q: flash_attention(
+        q, q, q, causal=True, block_q=512, block_k=512).sum()))
+    rg = jax.jit(jax.grad(lambda q: attention_reference(
+        q, q, q, causal=True).sum()))
+    jax.block_until_ready(fg(inputs[0]))
+    jax.block_until_ready(rg(inputs[1]))  # compile
+    t0 = time.perf_counter()
+    for i in range(n):
+        jax.block_until_ready(fg(inputs[2 + i]))
+    tfg = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for i in range(n):
+        jax.block_until_ready(rg(inputs[2 + n + i]))
+    trg = (time.perf_counter() - t0) / n
+    _emit("flash_attention_fwd_bwd_vs_xla", trg / tfg, "speedup_x",
+          {"seq": S, "flash_ms": round(tfg * 1e3, 2),
+           "xla_ms": round(trg * 1e3, 2)})
+
 
 def main():
     import os

@@ -10,7 +10,7 @@ from here, so the same class definitions drop into a genuine
 
 from __future__ import annotations
 
-try:  # pragma: no cover - exercised only on clusters with pyspark installed
+try:  # covered by the pyspark CI job (make test-pyspark); absent locally
     from pyspark import keyword_only
     from pyspark.ml import Model
     from pyspark.ml.base import Estimator, Transformer

@@ -96,9 +96,9 @@ def attention_reference(q, k, v, causal: bool = False,
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
                   block_q: int, block_k: int, has_mask: bool):
     if has_mask:
-        mask_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
         mask_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -146,10 +146,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
     @pl.when(ki == nk - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # logsumexp per q row — the backward kernels recompute p from it
+        lse_ref[0] = (m_ref[:, 0]
+                      + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
 
 
 def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
-                          interpret):
+                          interpret, with_lse=False):
     b, h, s, d = q.shape
     sk = k.shape[2]
     qf = q.reshape(b * h, s, d)
@@ -171,12 +174,14 @@ def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
         in_specs.append(pl.BlockSpec((1, block_k),
                                      lambda bh, qi, ki, _h=h: (bh // _h, ki)))
         args.append(kv_mask.astype(jnp.float32))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, sk // block_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+                   pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi))),
+        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, s), jnp.float32)),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -186,7 +191,10 @@ def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, s, d)
+    out = out.reshape(b, h, s, d)
+    if with_lse:
+        return out, lse.reshape(b, h, s)
+    return out
 
 
 def _blockwise_attention(q, k, v, kv_mask, causal, scale, block_k=512):
@@ -224,6 +232,196 @@ def _blockwise_attention(q, k, v, kv_mask, causal, scale, block_k=512):
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas flash attention backward (dq and dk/dv kernels, flash-style recompute)
+#
+# Standard recurrence (Dao, FlashAttention-2): with row stats L = logsumexp
+# saved by the forward and D_i = rowsum(dO_i * O_i),
+#   P   = exp(S - L);  dV = P^T dO;  dP = dO V^T
+#   dS  = P * (dP - D);  dQ = scale * dS K;  dK = scale * dS^T Q
+# The S x S matrices exist only block-by-block in VMEM, same as the forward.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_p_block(q, k, lse, sm_scale, causal, qi0, ki0, mask_vec):
+    """Recompute the normalized probability block P = exp(S - L) [bq, bk];
+    masked/causal-excluded entries are exactly 0 (no exp of NEG_INF deltas)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi0
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki0
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    if mask_vec is not None:
+        s = jnp.where(mask_vec[None, :] > 0, s, NEG_INF)
+    # rows with every key masked have lse ~ NEG_INF; gate on s to keep p = 0
+    return jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - lse[:, None]), 0.0)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         *rest, sm_scale, causal, block_q, block_k, has_mask):
+    if has_mask:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        mask_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_p_block(q, k, lse_ref[0], sm_scale, causal,
+                         qi * block_q, ki * block_k,
+                         mask_ref[0] if mask_ref is not None else None)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc[:] += sm_scale * jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          *rest, sm_scale, causal, block_q, block_k, has_mask):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        mask_ref = None
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_p_block(q, k, lse_ref[0], sm_scale, causal,
+                         qi * block_q, ki * block_k,
+                         mask_ref[0] if mask_ref is not None else None)
+        # dV_j += P^T dO ; dK_j += scale * dS^T Q
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[:] += sm_scale * jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q blocks entirely above this k block's diagonal see p = 0
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
+                           block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
+    gf = g.reshape(bh, s, d)
+    lsef = lse.reshape(bh, s)
+    # D_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it fine
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, s)
+    has_mask = kv_mask is not None
+    maskf = kv_mask.astype(jnp.float32) if has_mask else None
+
+    common = dict(sm_scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, has_mask=has_mask)
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0))
+    row_q = pl.BlockSpec((1, block_q), lambda bh_, qi, ki: (bh_, qi))
+
+    in_specs_dq = [
+        qspec,
+        pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        qspec, row_q, row_q,
+    ]
+    args_dq = [qf, kf, vf, gf, lsef, delta]
+    if has_mask:
+        in_specs_dq.append(pl.BlockSpec(
+            (1, block_k), lambda bh_, qi, ki, _h=h: (bh_ // _h, ki)))
+        args_dq.append(maskf)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, s // block_q, sk // block_k),
+        in_specs=in_specs_dq,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args_dq)
+
+    kspec = pl.BlockSpec((1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0))
+    in_specs_kv = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0)),
+        kspec, kspec,
+        pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, block_q), lambda bh_, ki, qi: (bh_, qi)),
+        pl.BlockSpec((1, block_q), lambda bh_, ki, qi: (bh_, qi)),
+    ]
+    args_kv = [qf, kf, vf, gf, lsef, delta]
+    if has_mask:
+        in_specs_kv.append(pl.BlockSpec(
+            (1, block_k), lambda bh_, ki, qi, _h=h: (bh_ // _h, ki)))
+        args_kv.append(maskf)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, sk // block_k, s // block_q),
+        in_specs=in_specs_kv,
+        out_specs=(kspec, kspec),
+        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args_kv)
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
     return _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q,
@@ -231,18 +429,15 @@ def _flash(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, kv_mask, causal, scale, block_q, block_k, interpret):
-    out = _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q,
-                                block_k, interpret)
-    return out, (q, k, v, kv_mask)
+    out, lse = _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q,
+                                     block_k, interpret, with_lse=True)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, kv_mask = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_attention(q, k, v, kv_mask, causal, scale,
-                                             block_k=block_k),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_mask, out, lse = res
+    dq, dk, dv = _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal,
+                                        scale, block_q, block_k, interpret)
     return dq, dk, dv, None  # mask carries no gradient
 
 

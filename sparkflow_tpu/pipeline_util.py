@@ -39,7 +39,7 @@ def _from_bytes_string(s: str) -> Any:
     return dill.loads(zlib.decompress(raw))
 
 
-if USING_PYSPARK:  # pragma: no cover - requires a JVM/pyspark environment
+if USING_PYSPARK:  # covered by the pyspark CI job (make test-pyspark)
 
     from pyspark.ml.feature import StopWordsRemover
     from pyspark.ml.pipeline import Pipeline, PipelineModel
@@ -50,6 +50,23 @@ if USING_PYSPARK:  # pragma: no cover - requires a JVM/pyspark environment
 
         _getCarrierClass = staticmethod(lambda: StopWordsRemover)
         GUID = GUID
+
+    def _unwrap_carrier(words: List[str], what: str = "stage") -> Any:
+        """Single decode path for every carrier consumer (reader, _from_java,
+        pipeline unwrap): validate the GUID sentinel, then dill-load."""
+        words = list(words)
+        if len(words) < 2 or words[-1] != GUID:
+            raise ValueError(f"{what} is not a sparkflow-tpu carrier")
+        return _from_bytes_string(words[0])
+
+    class _CarrierReader:
+        """Loads a saved carrier StopWordsRemover and unwraps the Python
+        stage (reference ``pipeline_util.py:89-98``: the reader is for the
+        CARRIER class — a Python-only class has no Java loader)."""
+
+        def load(self, path: str):
+            carrier = JavaMLReader(StopWordsRemover).load(path)
+            return _unwrap_carrier(carrier.getStopWords(), what=path)
 
     class PysparkReaderWriter:
         """Mixin giving a Python stage Spark-native save/load via the
@@ -63,21 +80,22 @@ if USING_PYSPARK:  # pragma: no cover - requires a JVM/pyspark environment
 
         @classmethod
         def read(cls):
-            return JavaMLReader(cls)
+            return _CarrierReader()
+
+        @classmethod
+        def load(cls, path: str):
+            return cls.read().load(path)
 
         def _to_java(self):
             payload = _to_bytes_string(self)
-            carrier = StopWordsRemover(uid=self.uid)
+            carrier = StopWordsRemover()
+            carrier._resetUid(self.uid)  # keep stage identity in metadata
             carrier.setStopWords([payload, GUID])
             return carrier._to_java()
 
         @classmethod
         def _from_java(cls, java_stage):
-            carrier = StopWordsRemover._from_java(java_stage)
-            words = carrier.getStopWords()
-            if len(words) < 2 or words[-1] != GUID:
-                raise ValueError("stage is not a sparkflow-tpu carrier")
-            return _from_bytes_string(words[0])
+            return _unwrap_carrier(java_stage.getStopWords())
 
     class PysparkPipelineWrapper:
         """Recursively swap carrier stages back into real Python objects after
@@ -94,7 +112,11 @@ if USING_PYSPARK:  # pragma: no cover - requires a JVM/pyspark environment
                     elif (isinstance(stage, StopWordsRemover)
                           and stage.getStopWords()
                           and stage.getStopWords()[-1] == GUID):
-                        stages[i] = _from_bytes_string(stage.getStopWords()[0])
+                        stages[i] = _unwrap_carrier(stage.getStopWords())
+                if isinstance(pipeline, Pipeline):
+                    pipeline.setStages(stages)
+                else:
+                    pipeline.stages = stages
             return pipeline
 
 else:

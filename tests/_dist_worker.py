@@ -1,0 +1,74 @@
+"""Worker process for the 2-process jax.distributed test (CPU backend).
+
+Usage: python tests/_dist_worker.py <process_id> <num_processes> <port>
+
+Forms the global process group via sparkflow_tpu.parallel.distributed, builds
+a global dp mesh spanning both processes' devices, assembles per-host shards
+into one global array, runs a psum-backed global reduction and one
+data-parallel train step, and prints machine-checkable lines.
+"""
+
+import sys
+
+import jax
+
+# must precede any device use; env JAX_PLATFORMS can be overridden by
+# site customizations in some images (see tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from sparkflow_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    mesh = dist.global_mesh({"dp": -1})
+    assert mesh.devices.size == nproc * 2  # 2 cpu devices per process
+    print(f"GROUP ok process={pid}/{jax.process_count()} "
+          f"devices={mesh.devices.size}", flush=True)
+
+    # per-host shard -> global array; rows are globally distinguishable
+    local = (np.arange(8, dtype=np.float32) + 1000.0 * pid).reshape(4, 2)
+    g = dist.host_shard_to_global(local, mesh)
+    assert g.shape == (4 * nproc, 2)
+    total = jax.jit(lambda x: x.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(g)
+    # expected: sum over all hosts' rows = sum_p sum(arange(8) + 1000p)
+    expect = sum(float(np.sum(np.arange(8) + 1000.0 * p))
+                 for p in range(nproc))
+    assert abs(float(total) - expect) < 1e-3, (float(total), expect)
+    print(f"GLOBAL_SUM ok {float(total)}", flush=True)
+
+    # one synchronous data-parallel train step over the global mesh: the
+    # gradient all-reduce crosses the process boundary
+    import optax
+    from sparkflow_tpu.core import make_train_step
+
+    def loss_fn(params, x, y, mask, rng):
+        pred = x @ params["w"]
+        return jnp.sum((pred - y[:, 0]) ** 2 * mask) / jnp.sum(mask)
+
+    step = make_train_step(loss_fn, optax.sgd(0.1), mesh)
+    params = {"w": jnp.zeros((2,), jnp.float32)}
+    opt_state = optax.sgd(0.1).init(params)
+    y = dist.host_shard_to_global(
+        np.ones((4, 1), np.float32) * (pid + 1), mesh)
+    mask = dist.host_shard_to_global(np.ones((4,), np.float32), mesh)
+    params, opt_state, loss = step(params, opt_state, g, y, mask,
+                                   jax.random.PRNGKey(0))
+    w = np.asarray(jax.device_get(params["w"]))
+    print(f"TRAIN_STEP ok loss={float(loss):.4f} "
+          f"w={w[0]:.6f},{w[1]:.6f}", flush=True)
+    assert dist.process_local_batch(8 * nproc) == 8
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

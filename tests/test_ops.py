@@ -135,3 +135,35 @@ def test_force_xla_attention_skips_pallas(monkeypatch):
     ref = A.attention_reference(q, q, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bwd_nonuniform_cotangent(qkv):
+    """The pallas backward kernels (dq/dk/dv) under a structured cotangent —
+    uniform .sum() grads can hide transposition errors."""
+    q, k, v = qkv
+    rs = np.random.RandomState(9)
+    w = jnp.asarray(rs.randn(*q.shape), jnp.float32)
+    for causal in (False, True):
+        gf = jax.grad(lambda a, b, c: (flash_attention(
+            a, b, c, causal=causal, interpret=True) * w).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: (attention_reference(
+            a, b, c, causal=causal) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3)
+
+
+def test_flash_bwd_bf16():
+    rs = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rs.randn(1, 2, 128, 64), jnp.bfloat16)
+               for _ in range(3))
+    gf = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, interpret=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: attention_reference(
+        a, b, c).astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=0.15)
