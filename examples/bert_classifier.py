@@ -34,8 +34,15 @@ def synthetic_text(spark, n, seq_len, vocab):
         ids = rs.randint(10, vocab, seq_len)
         if label:
             ids[:: 7] = 3  # a "positive" marker token pattern
-        rows.append((float(label), Vectors.dense(ids.astype(float))))
-    return spark.createDataFrame(rows, ["label", "tokens"])
+        # variable-length documents: real tokens then padding, with the
+        # attention mask travelling as its own column
+        n_real = rs.randint(seq_len // 2, seq_len + 1)
+        mask = np.zeros(seq_len)
+        mask[:n_real] = 1.0
+        ids[n_real:] = 0
+        rows.append((float(label), Vectors.dense(ids.astype(float)),
+                     Vectors.dense(mask)))
+    return spark.createDataFrame(rows, ["label", "tokens", "mask"])
 
 
 if __name__ == "__main__":
@@ -64,7 +71,11 @@ if __name__ == "__main__":
         iters=3 if SMOKE else 10,
         miniBatchSize=32,
         labelCol="labels",
-        predictionCol="predicted")
+        predictionCol="predicted",
+        # multi-input feed: the attention mask rides a second column into a
+        # second graph tensor (train AND transform)
+        extraInputCols="mask",
+        extraTfInputs="attention_mask:0")
 
     pipe = Pipeline(stages=[
         OneHotEncoder(inputCol="label", outputCol="labels", dropLast=False),

@@ -44,9 +44,18 @@ def _masked_mean(loss_vec: jax.Array, mask: jax.Array) -> jax.Array:
     return jnp.sum(loss_vec * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def make_loss_fn(model: GraphModel, input_name: str,
+def _rows(x) -> int:
+    """Row count of a features value (one array or a tuple of arrays)."""
+    return jax.tree.leaves(x)[0].shape[0]
+
+
+def make_loss_fn(model: GraphModel, input_name,
                  label_name: Optional[str]) -> Callable:
     """Build ``loss_fn(params, x, y, mask, rng) -> scalar`` from a GraphModel.
+
+    ``input_name`` is one tensor name or a sequence of names — with a
+    sequence, ``x`` is a matching tuple of arrays (multi-input models, e.g. a
+    transformer fed ``input_ids`` + ``attention_mask``).
 
     ``label_name=None`` is the unsupervised path (reference ``tfLabel=None``,
     e.g. the autoencoder example). The dropout placeholder is deliberately NOT
@@ -54,11 +63,14 @@ def make_loss_fn(model: GraphModel, input_name: str,
     where workers feed only input+label while training
     (``sparkflow/ml_util.py:109-118``) and the dropout feed exists only on the
     predict path (``sparkflow/ml_util.py:70-71``)."""
-    in_key = input_name.split(":")[0]
+    multi = isinstance(input_name, (list, tuple))
+    in_keys = ([n.split(":")[0] for n in input_name] if multi
+               else [input_name.split(":")[0]])
     lbl_key = label_name.split(":")[0] if label_name else None
 
     def loss_fn(params, x, y, mask, rng):
-        feeds = {in_key: x}
+        xs = tuple(x) if multi else (x,)
+        feeds = dict(zip(in_keys, xs))
         if lbl_key is not None:
             feeds[lbl_key] = y
         lv = model.loss_vector(params, feeds, train=True, rng=rng)
@@ -137,13 +149,16 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                             (reference mode (c), ``:84-92``)
 
     Signature: ``epoch(params, opt_state, data, labels, mask, rng) ->
-    (params, opt_state, losses[num_batches])``. ``data`` has shape
+    (params, opt_state, losses[num_batches])``. ``data`` is one array — or a
+    tuple of arrays for multi-input models — of shape
     ``[num_batches*batch_size, ...]`` (already padded); labels may be a dummy
     array when unsupervised.
     """
 
     def epoch(params, opt_state, data, labels, mask, rng):
         used = num_batches * batch_size  # may differ from len(data) in stochastic mode
+        take = lambda tree, ix: jax.tree.map(
+            lambda a: jnp.take(a, ix, axis=0), tree)
         perm_rng, rng = jax.random.split(rng)
         if mode == "stochastic":
             # num_batches independent mini-batches, each sampled without
@@ -153,7 +168,7 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
             # occupy batch slots, so every batch trains on batch_size real
             # examples (unless the batch exceeds the dataset, where the
             # remainder is masked padding).
-            nr = n_real if n_real is not None else data.shape[0]
+            nr = n_real if n_real is not None else _rows(data)
 
             def batch_idx(r):
                 perm = jax.random.permutation(r, nr)
@@ -164,12 +179,12 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
             idx = jax.vmap(batch_idx)(
                 jax.random.split(perm_rng, num_batches)).reshape(-1)
-            data_e = jnp.take(data, idx, axis=0)
+            data_e = take(data, idx)
             labels_e = jnp.take(labels, idx, axis=0)
             mask_e = jnp.take(mask, idx, axis=0)
         elif shuffle:
-            perm = jax.random.permutation(perm_rng, data.shape[0])
-            data_e = jnp.take(data, perm, axis=0)
+            perm = jax.random.permutation(perm_rng, _rows(data))
+            data_e = take(data, perm)
             labels_e = jnp.take(labels, perm, axis=0)
             mask_e = jnp.take(mask, perm, axis=0)
         else:
@@ -178,7 +193,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
         def reshape_b(a):
             return a[:used].reshape((num_batches, batch_size) + a.shape[1:])
 
-        xb, yb, mb = reshape_b(data_e), reshape_b(labels_e), reshape_b(mask_e)
+        xb = jax.tree.map(reshape_b, data_e)
+        yb, mb = reshape_b(labels_e), reshape_b(mask_e)
         step_rngs = jax.random.split(rng, num_batches)
         step = _step_body(loss_fn, optimizer)
 
@@ -222,16 +238,19 @@ def pad_to_batches(x: np.ndarray, batch_size: int,
     return np.concatenate([x, pad], axis=0), mask
 
 
-def make_predict_fn(model: GraphModel, input_name: str, output_name: str,
+def make_predict_fn(model: GraphModel, input_name, output_name: str,
                     dropout_name: Optional[str] = None,
                     dropout_value: float = 1.0) -> Callable:
-    """Jitted fixed-shape inference: ``predict(params, x) -> out``."""
-    in_key = input_name.split(":")[0]
+    """Jitted fixed-shape inference: ``predict(params, x) -> out``.
+    ``input_name`` may be a sequence of names; ``x`` is then a tuple."""
+    multi = isinstance(input_name, (list, tuple))
+    in_keys = ([n.split(":")[0] for n in input_name] if multi
+               else [input_name.split(":")[0]])
     drop_key = dropout_name.split(":")[0] if dropout_name else None
 
     @jax.jit
     def predict(params, x):
-        feeds = {in_key: x}
+        feeds = dict(zip(in_keys, tuple(x) if multi else (x,)))
         if drop_key is not None:
             feeds[drop_key] = jnp.asarray(dropout_value, jnp.float32)
         return model.apply(params, feeds, [output_name], train=False)[output_name]
@@ -239,29 +258,45 @@ def make_predict_fn(model: GraphModel, input_name: str, output_name: str,
     return predict
 
 
-def predict_in_chunks(predict_fn: Callable, params, x: np.ndarray,
+def predict_in_chunks(predict_fn: Callable, params, x,
                       chunk_size: int = 4096) -> np.ndarray:
     """Run fixed-shape chunks over arbitrary-length input (pad+trim the tail).
+    ``x`` is one array or a tuple of arrays (multi-input models).
 
     The reference fed the entire partition as one batch
     (``sparkflow/ml_util.py:69-73``); fixed chunks bound memory and compile once.
     """
-    n = x.shape[0]
+    multi = isinstance(x, (list, tuple))
+    if multi:
+        xs = tuple(np.asarray(a) for a in x)
+        n = xs[0].shape[0]
+        zeros = lambda m: tuple(np.zeros((m,) + a.shape[1:], a.dtype)
+                                for a in xs)
+        sl = lambda i, j: tuple(a[i:j] for a in xs)
+        cat = lambda parts, pad: tuple(
+            np.concatenate([p, z], axis=0) for p, z in zip(parts, pad))
+    else:
+        xs = np.asarray(x)
+        n = xs.shape[0]
+        zeros = lambda m: np.zeros((m,) + xs.shape[1:], xs.dtype)
+        sl = lambda i, j: xs[i:j]
+        cat = lambda part, pad: np.concatenate([part, pad], axis=0)
     if n == 0:
         # derive the output rank/dtype from a single zero row so empty
         # partitions concatenate cleanly with non-empty ones
-        probe = np.asarray(predict_fn(params, np.zeros((1,) + x.shape[1:], x.dtype)))
+        probe = np.asarray(predict_fn(params, zeros(1)))
         return probe[:0]
     chunk = min(chunk_size, max(1, 1 << (n - 1).bit_length()))
     outs = []
     i = 0
     while i < n:
-        sl = x[i:i + chunk]
-        if sl.shape[0] < chunk:
-            pad = np.zeros((chunk - sl.shape[0],) + sl.shape[1:], sl.dtype)
-            out = np.asarray(predict_fn(params, np.concatenate([sl, pad], 0)))[:sl.shape[0]]
+        part = sl(i, i + chunk)
+        have = (part[0] if multi else part).shape[0]
+        if have < chunk:
+            out = np.asarray(predict_fn(params,
+                                        cat(part, zeros(chunk - have))))[:have]
         else:
-            out = np.asarray(predict_fn(params, sl))
+            out = np.asarray(predict_fn(params, part))
         outs.append(out)
         i += chunk
     return np.concatenate(outs, axis=0)

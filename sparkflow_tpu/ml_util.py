@@ -82,13 +82,15 @@ _PREDICT_CACHE: "OrderedDict[Tuple[str, str, str, Optional[str], float], Any]" =
 _PREDICT_CACHE_MAX = 32
 
 
-def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
+def _cached_predict_fn(graph_json: str, tf_output: str, tf_input,
                        tf_dropout: Optional[str], dropout_value: float):
     """Cache (model, predict_fn) across partitions — the reference rebuilt the
     whole session per partition (``ml_util.py:61-68``); one compiled program
     serves all partitions here."""
     digest = hashlib.sha256(graph_json.encode()).hexdigest()
-    key = (digest, tf_output, tf_input, tf_dropout, dropout_value)
+    in_key = (tuple(tf_input) if isinstance(tf_input, (list, tuple))
+              else tf_input)
+    key = (digest, tf_output, in_key, tf_dropout, dropout_value)
     if key not in _PREDICT_CACHE:
         from .models import model_from_json
         model = model_from_json(graph_json)
@@ -104,17 +106,28 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input: str,
 def predict_func(rows: Iterable, graph_json: str, prediction: str,
                  graph_weights: str, inp: str, activation: str, tf_input: str,
                  tf_dropout: Optional[str] = None, to_keep_dropout: bool = False,
-                 chunk_size: int = 4096) -> List:
+                 chunk_size: int = 4096, extra_cols: Optional[List[str]] = None,
+                 extra_inputs: Optional[List[str]] = None) -> List:
     """Per-partition inference (same signature/meaning as
-    ``sparkflow/ml_util.py:54``). ``activation`` is the output tensor name."""
+    ``sparkflow/ml_util.py:54``). ``activation`` is the output tensor name.
+    ``extra_cols``/``extra_inputs`` feed additional columns to additional
+    tensors (multi-input models, e.g. an attention mask)."""
+    if bool(extra_cols) != bool(extra_inputs) or (
+            extra_cols and len(extra_cols) != len(extra_inputs)):
+        raise ValueError("extra_cols and extra_inputs must pair up one-to-one")
     row_dicts = [r.asDict() for r in rows]
     if not row_dicts:
         return []
     dropout_v = 1.0 if (tf_dropout is not None and to_keep_dropout) else 0.0
-    model, fn = _cached_predict_fn(graph_json, activation, tf_input,
+    names = [tf_input] + list(extra_inputs) if extra_cols else tf_input
+    model, fn = _cached_predict_fn(graph_json, activation, names,
                                    tf_dropout, dropout_v)
     params = list_to_params(model, resolve_weights(graph_weights))
-    x = np.stack([vector_to_array(rd[inp]) for rd in row_dicts]).astype(np.float32)
+    cols = [inp] + list(extra_cols) if extra_cols else [inp]
+    stacked = tuple(
+        np.stack([vector_to_array(rd[c]) for rd in row_dicts]).astype(np.float32)
+        for c in cols)
+    x = stacked if extra_cols else stacked[0]
     preds = predict_in_chunks(fn, params, x, chunk_size)
     for rd, p in zip(row_dicts, preds):
         arr = np.asarray(p)
@@ -131,10 +144,16 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
 
 
 def handle_features(data: Iterable, is_supervised: bool = False
-                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+                    ) -> Tuple[Any, Optional[np.ndarray]]:
     """Materialize an iterator of (features, label) / features into arrays.
-    Scalar labels wrap to ``[y]`` (reference ``ml_util.py:86-101``)."""
+    Scalar labels wrap to ``[y]`` (reference ``ml_util.py:86-101``). A row's
+    features may be a TUPLE of vectors (multi-input models); the return is
+    then a matching tuple of arrays."""
+    def to_arr(x):
+        return x if isinstance(x, np.ndarray) else vector_to_array(x)
+
     features, labels = [], []
+    multi = False
     for item in data:
         if is_supervised:
             x, y = item
@@ -142,10 +161,18 @@ def handle_features(data: Iterable, is_supervised: bool = False
                 labels.append([y])
             else:
                 labels.append(vector_to_array(y))
-            features.append(vector_to_array(x) if not isinstance(x, np.ndarray) else x)
         else:
-            features.append(vector_to_array(item) if not isinstance(item, np.ndarray) else item)
-    f = np.asarray(features, dtype=np.float32)
+            x = item
+        if isinstance(x, tuple):
+            multi = True
+            features.append([to_arr(c) for c in x])
+        else:
+            features.append(to_arr(x))
+    if multi:
+        f = tuple(np.asarray([row[i] for row in features], dtype=np.float32)
+                  for i in range(len(features[0])))
+    else:
+        f = np.asarray(features, dtype=np.float32)
     l = np.asarray(labels, dtype=np.float32) if is_supervised else None
     return f, l
 

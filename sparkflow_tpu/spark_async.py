@@ -36,19 +36,33 @@ from .pipeline_util import PysparkReaderWriter
 from .trainer import Trainer
 
 
+def _split_csv(s: Optional[str]) -> list:
+    """Comma-separated Param -> list of names (empty list for None/blank)."""
+    return [t.strip() for t in (s or "").split(",") if t.strip()]
+
+
 def build_optimizer(optimizer_name, learning_rate, optimizer_options=None):
     """Name -> optax transformation (reference ``tensorflow_async.py:17-42``)."""
     from .optimizers import build_optimizer as _bo
     return _bo(optimizer_name, learning_rate, optimizer_options)
 
 
-def handle_data(data, inp_col: str, label_col: Optional[str]):
+def handle_data(data, inp_col: str, label_col: Optional[str],
+                extra_cols: Optional[list] = None):
     """Row -> (features ndarray, label) or bare features when unsupervised
-    (reference ``tensorflow_async.py:45-48``)."""
+    (reference ``tensorflow_async.py:45-48``). With ``extra_cols`` the
+    features become a tuple (multi-input models)."""
+    def feat(row):
+        base = np.asarray(vector_to_array(row[inp_col]), dtype=np.float32)
+        if extra_cols:
+            return (base,) + tuple(
+                np.asarray(vector_to_array(row[c]), dtype=np.float32)
+                for c in extra_cols)
+        return base
+
     if label_col is None:
-        return np.asarray(vector_to_array(data[inp_col]), dtype=np.float32)
-    return (np.asarray(vector_to_array(data[inp_col]), dtype=np.float32),
-            data[label_col])
+        return feat(data)
+    return (feat(data), data[label_col])
 
 
 class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWriter,
@@ -62,6 +76,11 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
     tfInput = Param(Params._dummy(), "tfInput", "", typeConverter=TypeConverters.toString)
     tfDropout = Param(Params._dummy(), "tfDropout", "", typeConverter=TypeConverters.toString)
     toKeepDropout = Param(Params._dummy(), "toKeepDropout", "", typeConverter=TypeConverters.toBoolean)
+    # upgrade: extra (column, tensor) feeds for multi-input models, e.g. an
+    # attention mask next to token ids; comma-separated so the Params stay
+    # plain strings (persistence-friendly, like every reference Param)
+    extraInputCols = Param(Params._dummy(), "extraInputCols", "", typeConverter=TypeConverters.toString)
+    extraTfInputs = Param(Params._dummy(), "extraTfInputs", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -72,11 +91,14 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                  tfOutput=None,
                  tfDropout=None,
                  toKeepDropout=None,
-                 predictionCol=None):
+                 predictionCol=None,
+                 extraInputCols=None,
+                 extraTfInputs=None):
         super(SparkAsyncDLModel, self).__init__()
         self._setDefault(modelJson=None, inputCol='encoded',
                          predictionCol='predicted', tfOutput=None, tfInput=None,
-                         modelWeights=None, tfDropout=None, toKeepDropout=False)
+                         modelWeights=None, tfDropout=None, toKeepDropout=False,
+                         extraInputCols=None, extraTfInputs=None)
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -89,7 +111,9 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                   tfOutput=None,
                   tfDropout=None,
                   toKeepDropout=None,
-                  predictionCol=None):
+                  predictionCol=None,
+                  extraInputCols=None,
+                  extraTfInputs=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -102,9 +126,17 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
         tf_output = self.getOrDefault(self.tfOutput)
         tf_dropout = self.getOrDefault(self.tfDropout)
         to_keep_dropout = self.getOrDefault(self.toKeepDropout)
+        extra_cols = _split_csv(self.getOrDefault(self.extraInputCols))
+        extra_inputs = _split_csv(self.getOrDefault(self.extraTfInputs))
+        if len(extra_cols) != len(extra_inputs):
+            raise ValueError(
+                "extraInputCols (%d names) and extraTfInputs (%d names) must "
+                "pair up one-to-one" % (len(extra_cols), len(extra_inputs)))
         return dataset.rdd.mapPartitions(
             lambda x: predict_func(x, mod_json, out, mod_weights, inp, tf_output,
-                                   tf_input, tf_dropout, to_keep_dropout)).toDF()
+                                   tf_input, tf_dropout, to_keep_dropout,
+                                   extra_cols=extra_cols or None,
+                                   extra_inputs=extra_inputs or None)).toDF()
 
 
 class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
@@ -145,6 +177,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # stream mode the `partitions` Param is the streaming granularity: one
     # partition is the most data resident on the driver at once.
     fitMode = Param(Params._dummy(), "fitMode", "", typeConverter=TypeConverters.toString)
+    # extra (column, tensor) feeds for multi-input models (see the Model)
+    extraInputCols = Param(Params._dummy(), "extraInputCols", "", typeConverter=TypeConverters.toString)
+    extraTfInputs = Param(Params._dummy(), "extraTfInputs", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -172,7 +207,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  weightsPath=None,
                  checkpointDir=None,
                  checkpointEvery=None,
-                 fitMode=None):
+                 fitMode=None,
+                 extraInputCols=None,
+                 extraTfInputs=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -188,7 +225,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          predictionCol='predicted', labelCol=None,
                          partitionShuffles=1, optimizerOptions=None, port=5000,
                          weightsPath=None, checkpointDir=None, checkpointEvery=0,
-                         fitMode='collect')
+                         fitMode='collect', extraInputCols=None,
+                         extraTfInputs=None)
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -219,7 +257,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   weightsPath=None,
                   checkpointDir=None,
                   checkpointEvery=None,
-                  fitMode=None):
+                  fitMode=None,
+                  extraInputCols=None,
+                  extraTfInputs=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -310,7 +350,16 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if fit_mode not in ("collect", "stream"):
             raise ValueError("fitMode must be 'collect' or 'stream', got %r"
                              % self.getFitMode())
-        return fit_mode
+        extra_cols = _split_csv(self.getOrDefault(self.extraInputCols))
+        extra_inputs = _split_csv(self.getOrDefault(self.extraTfInputs))
+        if len(extra_cols) != len(extra_inputs):
+            raise ValueError(
+                "extraInputCols (%d names) and extraTfInputs (%d names) must "
+                "pair up one-to-one" % (len(extra_cols), len(extra_inputs)))
+        if extra_cols and fit_mode == "stream":
+            raise ValueError("fitMode='stream' supports a single input "
+                             "column; use collect mode for multi-input models")
+        return fit_mode, extra_cols, extra_inputs
 
     def _fit(self, dataset):
         inp_col = self.getOrDefault(self.inputCol)
@@ -318,13 +367,15 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         label_col = self.getOrDefault(self.labelCol)
         tf_label = self.getTfLabel()
         optimizer_options = self.getOptimizerOptions()
-        fit_mode = self._validate_params()
+        fit_mode, extra_cols, extra_inputs = self._validate_params()
 
         # DataFrame -> (features, label) pairs; partitions Param shapes the RDD
         # exactly as the reference does (tensorflow_async.py:290-291). In
         # collect mode the union of partition data is staged onto the device
         # mesh; in stream mode partitions are consumed one at a time.
-        rdd = dataset.rdd.map(lambda r: handle_data(r, inp_col, label_col))
+        rdd = dataset.rdd.map(
+            lambda r: handle_data(r, inp_col, label_col,
+                                  extra_cols=extra_cols or None))
         partitions = self.getPartitions()
         if rdd.getNumPartitions() > partitions:
             rdd = rdd.coalesce(partitions)
@@ -332,9 +383,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         optimizer = build_optimizer_from_json(self.getTfOptimizer(),
                                               self.getTfLearningRate(),
                                               optimizer_options)
+        input_spec = ([self.getTfInput()] + extra_inputs if extra_inputs
+                      else self.getTfInput())
         trainer = Trainer(
             graph_json,
-            self.getTfInput(),
+            input_spec,
             tf_label,
             optimizer=optimizer,
             iters=self.getIters(),
@@ -399,4 +452,6 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             tfInput=self.getTfInput(),
             tfDropout=self.getTfDropout(),
             toKeepDropout=self.getToKeepDropout(),
-            predictionCol=self.getOrDefault(self.predictionCol))
+            predictionCol=self.getOrDefault(self.predictionCol),
+            extraInputCols=self.getOrDefault(self.extraInputCols),
+            extraTfInputs=self.getOrDefault(self.extraTfInputs))

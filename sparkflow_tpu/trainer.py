@@ -102,8 +102,12 @@ class Trainer:
         else:  # an executable model object (GraphModel or registry model)
             self.model = graph
         # fail fast on bad tensor names (otherwise they surface later as a
-        # confusing "placeholder not fed" error from the executor)
-        self.model.graphdef.resolve(input_name)
+        # confusing "placeholder not fed" error from the executor).
+        # input_name may be a sequence of tensor names (multi-input models,
+        # e.g. input_ids + attention_mask) — features then travel as a tuple.
+        for name in (input_name if isinstance(input_name, (list, tuple))
+                     else [input_name]):
+            self.model.graphdef.resolve(name)
         if label_name:
             self.model.graphdef.resolve(label_name)
         if dropout_name:
@@ -177,10 +181,25 @@ class Trainer:
 
     # -- fit ----------------------------------------------------------------
 
-    def fit(self, features: np.ndarray, labels: Optional[np.ndarray] = None,
+    def fit(self, features, labels: Optional[np.ndarray] = None,
             init_params=None) -> TrainResult:
-        features = np.ascontiguousarray(features, dtype=np.float32)
-        n = features.shape[0]
+        multi = isinstance(features, (list, tuple))
+        n_inputs = (len(self.input_name)
+                    if isinstance(self.input_name, (list, tuple)) else 1)
+        if multi != (n_inputs > 1) or (multi and len(features) != n_inputs):
+            got = f"a tuple of {len(features)} arrays" if multi else "one array"
+            raise ValueError(
+                f"model takes {n_inputs} input tensor(s) "
+                f"({self.input_name}) but fit() got {got}")
+        if multi:
+            features = tuple(np.ascontiguousarray(f, dtype=np.float32)
+                             for f in features)
+            n = features[0].shape[0]
+            if any(f.shape[0] != n for f in features):
+                raise ValueError("multi-input feature arrays disagree on rows")
+        else:
+            features = np.ascontiguousarray(features, dtype=np.float32)
+            n = features.shape[0]
         if n == 0:
             raise ValueError("no training data")
         if labels is not None:
@@ -192,7 +211,12 @@ class Trainer:
         # the padded dataset always covers exactly ceil(n/batch) windows; in
         # stochastic mode num_batches may exceed that (resampled permutations)
         total = -(-n // batch) * batch
-        x_pad, mask = pad_to_batches(features, batch, total // batch)
+        if multi:
+            padded = [pad_to_batches(f, batch, total // batch)
+                      for f in features]
+            x_pad, mask = tuple(p for p, _ in padded), padded[0][1]
+        else:
+            x_pad, mask = pad_to_batches(features, batch, total // batch)
         if labels is not None:
             y_pad, _ = pad_to_batches(labels, batch, total // batch)
         else:
@@ -238,7 +262,8 @@ class Trainer:
         epoch_fn = self._epoch_cache[cache_key]
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
-        device_args = (jnp.asarray(x_pad), jnp.asarray(y_pad), jnp.asarray(mask))
+        device_args = (jax.tree.map(jnp.asarray, x_pad), jnp.asarray(y_pad),
+                       jnp.asarray(mask))
 
         loss_by_it = {}  # device scalars; converted lazily to keep async dispatch
         t0 = time.perf_counter()
@@ -353,6 +378,9 @@ class Trainer:
         from .localml.linalg import vector_to_array
         from .utils.data import BatchQueue, feed_from_iterator
 
+        if isinstance(self.input_name, (list, tuple)):
+            raise ValueError("fit_stream feeds a single input tensor; use "
+                             "fit() for multi-input models")
         factory = row_iterator if callable(row_iterator) else None
         if epochs > 1 and factory is None:
             raise ValueError("epochs > 1 needs a callable iterator factory "

@@ -290,3 +290,31 @@ def test_fit_stream_checkpoints_and_resumes_weights(tmp_path):
     tr2.fit_stream(iter([(rs.rand(3).astype(np.float32), 1.0)] * 16))
     w_resumed = np.asarray(tr2.params["out/BiasAdd"]["kernel"])
     assert np.abs(w_resumed - w_after).max() < 0.1
+
+
+def test_trainer_multi_input_tuple_features():
+    """Trainer.fit with input_name as a list: features travel as a tuple
+    (transformer fed input_ids + attention_mask)."""
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+
+    spec = build_registry_spec("transformer_classifier", vocab_size=20,
+                               num_classes=2, hidden=16, num_layers=1,
+                               num_heads=2, mlp_dim=32, max_len=6,
+                               dropout=0.0)
+    m = model_from_json(spec)
+    rs = np.random.RandomState(0)
+    n = 50
+    ids = rs.randint(2, 20, (n, 6)).astype(np.float32)
+    lbl = rs.randint(0, 2, n)
+    ids[lbl == 1, 0] = 1.0
+    mask = np.ones((n, 6), np.float32)
+    y = np.eye(2, dtype=np.float32)[lbl]
+
+    tr = Trainer(m, ["input_ids:0", "attention_mask:0"], "y:0", iters=25,
+                 mini_batch_size=16, learning_rate=0.01)
+    res = tr.fit((ids, mask), y)
+    assert res.losses[-1] < res.losses[0]
+    from sparkflow_tpu.core import predict_in_chunks
+    preds = predict_in_chunks(tr.predict_fn("pred:0"), res.params,
+                              (ids, mask))
+    assert ((preds > 0.5) == lbl).mean() > 0.6
