@@ -18,6 +18,7 @@ from sparkflow_tpu.compat import USING_PYSPARK
 if USING_PYSPARK:
     from pyspark.sql import SparkSession
     from pyspark.ml.feature import OneHotEncoder
+    from pyspark.ml.linalg import Vectors
     from pyspark.ml.pipeline import Pipeline
 else:
     from sparkflow_tpu.localml import (LocalSession as SparkSession,

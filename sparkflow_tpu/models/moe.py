@@ -34,11 +34,12 @@ class _MoEMixin:
     """Replaces the dense FFN with a capacity-routed expert bank on MoE layers."""
 
     def _init_moe(self, num_experts: int, moe_every: int, aux_weight: float,
-                  capacity_factor: float = 1.25):
+                  capacity_factor: float = 1.25, router_top_k: int = 1):
         self.num_experts = num_experts
         self.moe_every = max(1, moe_every)
         self.aux_weight = aux_weight
         self.capacity_factor = capacity_factor
+        self.router_top_k = max(1, min(router_top_k, num_experts))
 
     def _is_moe_layer(self, i: int) -> bool:
         return (i % self.moe_every) == (self.moe_every - 1)
@@ -102,45 +103,58 @@ class _MoEMixin:
         """
         b, s, h = x.shape
         e = self.num_experts
+        k = self.router_top_k
         n = b * s
-        c = self._capacity(n)
+        c = self._capacity(n * k)
         xf = x.reshape(n, h)
 
         router_logits = jnp.einsum("nh,he->ne", xf.astype(jnp.float32),
                                    bp["router"])
         probs = jax.nn.softmax(router_logits, axis=-1)           # [N,E]
-        expert_idx = jnp.argmax(probs, axis=-1)                  # [N]
-        gate = jnp.max(probs, axis=-1)                           # [N]
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        top_vals, top_idx = jax.lax.top_k(probs, k)              # [N,k]
+        if k == 1:
+            gates = top_vals  # Switch semantics: gate = max prob
+        else:
+            # GShard top-k: gates renormalized over the chosen experts
+            gates = top_vals / jnp.maximum(
+                jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
         if token_mask is not None:
             live = token_mask.reshape(n).astype(jnp.float32)
-            onehot = onehot * live[:, None]                      # dead rows: no slot
         else:
             live = None
 
-        # Switch load-balancing loss over live tokens:
-        # E * sum_e frac_tokens_e * mean_prob_e
+        onehots = [jax.nn.one_hot(top_idx[:, ci], e, dtype=jnp.float32)
+                   for ci in range(k)]
+        if live is not None:
+            onehots = [oh * live[:, None] for oh in onehots]
+
+        # Switch load-balancing loss over live tokens (first-choice fractions)
         denom = jnp.sum(live) if live is not None else float(n)
         denom = jnp.maximum(denom, 1.0)
         probs_live = probs * live[:, None] if live is not None else probs
-        aux = e * jnp.sum((jnp.sum(onehot, axis=0) / denom)
+        aux = e * jnp.sum((jnp.sum(onehots[0], axis=0) / denom)
                           * (jnp.sum(probs_live, axis=0) / denom))
 
-        # position of each token within its expert's buffer, in token order
-        pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
-                      axis=-1).astype(jnp.int32)                 # [N]
-        kept = pos < c
-        if live is not None:
-            kept = kept & (live > 0)
-        # flat slot id; dropped tokens all point at the overflow slot e*c
-        slot = jnp.where(kept, expert_idx.astype(jnp.int32) * c + pos, e * c)
-
-        # which token fills each slot; empty slots point at pad token index n
-        token_for_slot = jnp.full((e * c + 1,), n, dtype=jnp.int32)
-        token_for_slot = token_for_slot.at[slot].set(
-            jnp.arange(n, dtype=jnp.int32))[:e * c]
+        # buffer positions: ALL first choices claim capacity before any
+        # second choice (GShard priority), via cumsum over the stacked
+        # [k*N, E] assignment matrix in choice-major order
+        stacked = jnp.concatenate(onehots, axis=0)               # [k*N, E]
+        pos_all = jnp.cumsum(stacked, axis=0) - 1.0              # [k*N, E]
         xf_pad = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)], axis=0)
-        xe = xf_pad[token_for_slot].reshape(e, c, h)             # [E,C,H]
+        token_for_slot = jnp.full((e * c + 1,), n, dtype=jnp.int32)
+        slots = []
+        for ci in range(k):
+            oh = onehots[ci]
+            pos = jnp.sum(pos_all[ci * n:(ci + 1) * n] * oh,
+                          axis=-1).astype(jnp.int32)             # [N]
+            kept = (pos < c) & (jnp.sum(oh, axis=-1) > 0)
+            slot = jnp.where(kept,
+                             top_idx[:, ci].astype(jnp.int32) * c + pos,
+                             e * c)
+            token_for_slot = token_for_slot.at[slot].set(
+                jnp.arange(n, dtype=jnp.int32))
+            slots.append(slot)
+        xe = xf_pad[token_for_slot[:e * c]].reshape(e, c, h)     # [E,C,H]
 
         # expert FFN over the slot buffers; leading axis sharded over 'ep'
         hmid = jnp.einsum("ech,ehm->ecm", xe, bp["experts_fc1"].astype(xe.dtype))
@@ -148,10 +162,12 @@ class _MoEMixin:
         out = jnp.einsum("ecm,emh->ech", hmid, bp["experts_fc2"].astype(hmid.dtype))
         out = out + bp["experts_b2"].astype(out.dtype)[:, None, :]
 
-        # combine: each token reads its slot back; overflow slot row is zero
+        # combine: each token reads its k slots back, weighted by its gates;
+        # overflow slot row is zero (dropped choices contribute nothing)
         out_pad = jnp.concatenate([out.reshape(e * c, h),
                                    jnp.zeros((1, h), out.dtype)], axis=0)
-        y = out_pad[slot] * gate[:, None].astype(out.dtype)
+        y = sum(out_pad[slots[ci]] * gates[:, ci:ci + 1].astype(out.dtype)
+                for ci in range(k))
         return y.reshape(b, s, h).astype(x.dtype), aux
 
     def _block_aux(self, bp, x, mask, causal, train, rng):
@@ -180,9 +196,9 @@ class MoETransformerLM(_MoEMixin, _TransformerBase):
 
     def __init__(self, vocab_size: int, num_experts: int = 8, moe_every: int = 2,
                  router_aux_weight: float = 0.01,
-                 capacity_factor: float = 1.25, **kw):
+                 capacity_factor: float = 1.25, router_top_k: int = 1, **kw):
         self._init_moe(num_experts, moe_every, router_aux_weight,
-                       capacity_factor)
+                       capacity_factor, router_top_k)
         super().__init__(vocab_size, **kw)
         self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
         self.graphdef = _Names(self.TENSORS)

@@ -255,6 +255,23 @@ AVAILABLE_OPTIMIZERS = (
     "adagrad_da", "ftrl", "proximal_adagrad", "proximal_gradient_descent",
 )
 
+# name -> ctor(learning_rate=...) with registry defaults; the form
+# optax.inject_hyperparams needs for vmapped hyperparameter sweeps
+# (parallel/hyper.py). Unknown names fall back to sgd there, matching
+# build_optimizer's reference-parity fallback above.
+OPTIMIZER_BUILDERS = {
+    "adam": optax.adam,
+    "rmsprop": optax.rmsprop,
+    "momentum": lambda learning_rate: optax.sgd(learning_rate, momentum=0.9),
+    "adadelta": optax.adadelta,
+    "adagrad": optax.adagrad,
+    "gradient_descent": optax.sgd,
+    "ftrl": ftrl,
+    "adagrad_da": adagrad_da,
+    "proximal_adagrad": proximal_adagrad,
+    "proximal_gradient_descent": proximal_gradient_descent,
+}
+
 
 def build_optimizer_from_json(optimizer_name: str, learning_rate: Optional[float],
                               optimizer_options_json: Optional[str]) -> optax.GradientTransformation:

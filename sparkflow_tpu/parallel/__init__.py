@@ -10,11 +10,16 @@ fabric: a :class:`jax.sharding.Mesh` with named axes
 - ``tp``  — tensor parallelism (megatron-style sharded matmuls),
 - ``sp``  — sequence/context parallelism (ring attention over ICI),
 
-plus multi-host process groups via ``jax.distributed``. Collectives ride ICI
-within a slice and DCN across slices; there is no parameter server process.
+plus multi-host process groups via ``jax.distributed``, and vmapped
+hyperparameter parallelism (``hyper.hyperparameter_search`` — the reference's
+unshipped "Hyperopt" future-work item, realized as K configs in one XLA
+program). Collectives ride ICI within a slice and DCN across slices; there is
+no parameter server process.
 """
 
 from .mesh import default_mesh, make_mesh, mesh_axis_size
 from . import collectives
+from .hyper import HyperResult, hyperparameter_search
 
-__all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives"]
+__all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives",
+           "HyperResult", "hyperparameter_search"]

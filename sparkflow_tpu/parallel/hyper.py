@@ -1,0 +1,149 @@
+"""Hyperparameter parallelism: train K configurations in ONE compiled program.
+
+The reference lists "Hyperopt implementation" as future work
+(``/root/reference/README.md:234-236``) — it never shipped. On TPU the
+idiomatic realization is not K sequential jobs but ``jax.vmap`` over the
+hyperparameter axis: every model replica trains simultaneously inside one XLA
+program, so the MXU sees batched matmuls across configurations and K small
+models cost barely more than one. Learning rates become *data* via
+``optax.inject_hyperparams`` (the optimizer state carries the rate as a
+traced leaf, so one optimizer program serves every configuration).
+
+For configurations that change model STRUCTURE (layer sizes), fall back to
+sequential fits — vmap requires one trace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import make_loss_fn, pad_to_batches
+from ..optimizers import OPTIMIZER_BUILDERS
+
+
+class HyperResult:
+    """Outcome of a vmapped sweep, sorted views included."""
+
+    __slots__ = ("learning_rates", "final_losses", "loss_curves", "best_index",
+                 "best_learning_rate", "best_params")
+
+    def __init__(self, learning_rates, final_losses, loss_curves, best_index,
+                 best_params):
+        self.learning_rates = list(learning_rates)
+        self.final_losses = list(final_losses)
+        self.loss_curves = loss_curves
+        self.best_index = int(best_index)
+        self.best_learning_rate = self.learning_rates[self.best_index]
+        self.best_params = best_params
+
+
+def _injectable(optimizer_name: str):
+    """optax constructor for ``inject_hyperparams`` (name-compatible with the
+    registry; unknown names fall back to sgd like the reference's
+    build_optimizer, ``tensorflow_async.py:40-42``)."""
+    ctor = OPTIMIZER_BUILDERS.get(optimizer_name)
+    if ctor is None:
+        return optax.sgd
+    return ctor
+
+
+def hyperparameter_search(graph, input_name: str, label_name: Optional[str],
+                          features: np.ndarray,
+                          labels: Optional[np.ndarray],
+                          learning_rates: Sequence[float],
+                          optimizer: str = "adam",
+                          iters: int = 10,
+                          mini_batch_size: int = 128,
+                          seed: int = 0,
+                          same_init: bool = True) -> HyperResult:
+    """Train ``len(learning_rates)`` replicas of the model concurrently, one
+    per learning rate, and return per-config loss curves + the best params.
+
+    ``same_init=True`` gives every replica identical initial weights (isolates
+    the learning-rate effect); ``False`` gives each its own init seed.
+    """
+    from ..graphdef import GraphModel
+    from ..models import model_from_json
+
+    if isinstance(graph, str):
+        model = model_from_json(graph)
+    elif isinstance(graph, GraphModel) or hasattr(graph, "loss_vector"):
+        model = graph
+    else:
+        model = GraphModel(graph)
+
+    lrs = jnp.asarray(np.asarray(learning_rates, np.float64), jnp.float32)
+    k = lrs.shape[0]
+    loss_fn = make_loss_fn(model, input_name, label_name)
+
+    x = np.ascontiguousarray(features, dtype=np.float32)
+    n = x.shape[0]
+    if labels is not None:
+        y = np.ascontiguousarray(labels, dtype=np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+    else:
+        y = np.zeros((n, 1), np.float32)
+    batch = min(mini_batch_size if mini_batch_size > 0 else n, n)
+    num_batches = -(-n // batch)
+    x_pad, mask = pad_to_batches(x, batch, num_batches)
+    y_pad, _ = pad_to_batches(y, batch, num_batches)
+
+    ctor = _injectable(optimizer)
+    opt = optax.inject_hyperparams(ctor)(learning_rate=0.0)
+
+    def train_one(lr, init_rng, xp, yp, mk):
+        params = model.init(init_rng)
+        state = opt.init(params)
+        state.hyperparams["learning_rate"] = lr  # traced: one program, K rates
+
+        def epoch(carry, erng):
+            params, state = carry
+            shuffle_rng, step_root = jax.random.split(erng)
+            perm = jax.random.permutation(shuffle_rng, xp.shape[0])
+            xs = jnp.take(xp, perm, axis=0).reshape(
+                (num_batches, batch) + xp.shape[1:])
+            ys = jnp.take(yp, perm, axis=0).reshape(
+                (num_batches, batch) + yp.shape[1:])
+            ms = jnp.take(mk, perm, axis=0).reshape((num_batches, batch))
+            step_rngs = jax.random.split(step_root, num_batches)
+
+            def step(carry, b):
+                params, state = carry
+                xb, yb, mb, srng = b
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, xb, yb, mb, srng)
+                updates, state = opt.update(grads, state, params)
+                return (optax.apply_updates(params, updates), state), loss
+
+            (params, state), losses = jax.lax.scan(step, (params, state),
+                                                   (xs, ys, ms, step_rngs))
+            return (params, state), jnp.mean(losses)
+
+        # epoch rngs SHARED across configs (closure, not vmapped): every
+        # replica sees the same batch order, so curves differ only by the
+        # hyperparameter under study
+        (params, _), curve = jax.lax.scan(epoch, (params, state), epoch_rngs)
+        return params, curve
+
+    root = jax.random.PRNGKey(seed)
+    epoch_rngs = jax.random.split(jax.random.fold_in(root, 2), iters)
+    init_rngs = (jnp.tile(root[None], (k, 1)) if same_init
+                 else jax.random.split(jax.random.fold_in(root, 1), k))
+
+    # data is an ARGUMENT of the compiled program (staged once on device),
+    # not a closure constant baked into the HLO
+    params_k, curves = jax.jit(
+        jax.vmap(train_one, in_axes=(0, 0, None, None, None)))(
+        lrs, init_rngs, jnp.asarray(x_pad), jnp.asarray(y_pad),
+        jnp.asarray(mask))
+    final = np.asarray(curves[:, -1])
+    best = int(np.nanargmin(final))
+    best_params = jax.tree.map(lambda a: a[best], params_k)
+    return HyperResult(list(np.asarray(lrs)), list(final), np.asarray(curves),
+                       best, best_params)
