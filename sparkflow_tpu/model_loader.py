@@ -178,22 +178,44 @@ def load_tensorflow_model(path: str,
     """Import a TF1 Saver checkpoint into a fitted ``SparkAsyncDLModel``
     (reference ``load_tensorflow_model``, ``tensorflow_model_loader.py:8-32``).
 
-    The reference re-animated the checkpoint's MetaGraphDef in a tf.Session;
-    TF1 protobuf graphs are not executable here, so the serving graph must be
-    supplied as ``graph_json`` (the same model re-expressed in the
-    :mod:`sparkflow_tpu.nn` DSL — shape-validated against the checkpoint).
-    Weights are extracted directly from the checkpoint shards; TF is required
-    only for reading, never executed.
+    Like the reference, the checkpoint's own ``.meta`` MetaGraphDef is the
+    default serving graph (``tensorflow_model_loader.py:16-17``): it is
+    converted to JSON and executed by the :mod:`sparkflow_tpu.tf1_compat`
+    interpreter — no TF graph ever runs. Alternatively pass ``graph_json``
+    (a :mod:`sparkflow_tpu.nn` re-expression OR a MetaGraphDef JSON string).
+    Weights are read straight off the checkpoint shards; TF is required only
+    for reading.
     """
     if graph_json is None:
+        meta = path + ".meta"
+        if os.path.exists(meta):
+            try:
+                import tensorflow as tf
+                from google.protobuf import json_format
+                mg = tf.compat.v1.MetaGraphDef()
+                with open(meta, "rb") as f:
+                    mg.ParseFromString(f.read())
+                graph_json = json_format.MessageToJson(mg)
+            except ImportError:
+                pass  # fall through to the explicit error below
+            except Exception as e:  # corrupted/truncated .meta
+                raise ValueError(
+                    f"failed to parse {meta} as a MetaGraphDef ({e}); pass "
+                    f"graph_json= explicitly to bypass it") from e
+    if graph_json is None:
         raise ValueError(
-            "graph_json is required: TF1 MetaGraphDef graphs cannot execute "
-            "on this framework — rebuild the model with sparkflow_tpu.nn "
-            "(same layer order) and pass its build_graph() JSON here.")
+            "graph_json is required (no readable .meta next to the "
+            "checkpoint): pass the model re-expressed with sparkflow_tpu.nn "
+            "or a MetaGraphDef JSON string.")
     from .graphdef import list_to_params
     from .models import model_from_json
+    from .tf1_compat import TF1GraphModel
     model = model_from_json(graph_json)
     try:
+        if var_order is None and isinstance(model, TF1GraphModel):
+            # metagraph knows its variables BY NAME in creation order —
+            # exact assignment, no heuristics needed
+            var_order = list(model._var_order)
         if var_order is not None:
             weights = extract_tensorflow_weights(path, var_order=var_order)
         else:
@@ -202,7 +224,7 @@ def load_tensorflow_model(path: str,
             weights = _match_tf_weights_to_graph(_read_tf_variables(path),
                                                  model)
         list_to_params(model, weights)  # shape/count validation
-    except (ValueError, TypeError) as e:
+    except (ValueError, TypeError, KeyError) as e:
         raise ValueError(
             f"checkpoint variables do not match graph_json params: {e}. "
             f"If the checkpoint uses non-standard variable naming, pass "

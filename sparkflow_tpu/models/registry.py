@@ -37,6 +37,12 @@ def model_from_json(spec: str, compute_dtype: Optional[Any] = None):
             raise KeyError(f"unknown registry model {d['model']!r}; "
                            f"known: {sorted(_REGISTRY)}")
         return cls(compute_dtype=compute_dtype, **d["config"])
+    from ..tf1_compat import is_tf1_metagraph
+    if is_tf1_metagraph(d):
+        # a genuine TF1 MetaGraphDef JSON — the reference's wire format
+        # (sparkflow/graph_utils.py:6-15) — interpreted node-by-node in JAX
+        from ..tf1_compat import TF1GraphModel
+        return TF1GraphModel(d, compute_dtype)
     # default: graph-DSL spec
     from ..graphdef import GraphModel
     return GraphModel.from_json(spec, compute_dtype)

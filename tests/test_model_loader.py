@@ -99,10 +99,18 @@ def test_load_tensorflow_model_serves_checkpoint_weights(tf1_checkpoint):
                                atol=1e-5)
 
 
-def test_load_tensorflow_model_requires_graph(tf1_checkpoint):
+def test_load_tensorflow_model_requires_graph_when_no_meta(tf1_checkpoint,
+                                                           tmp_path):
+    """Without a .meta next to the checkpoint (and no graph_json), the error
+    is explicit. (With a .meta, the metagraph itself becomes the serving
+    graph — tests/test_tf1_compat.py.)"""
+    import shutil
     prefix, _ = tf1_checkpoint
+    stripped = str(tmp_path / "to_load")
+    for suf in (".index", ".data-00000-of-00001"):
+        shutil.copy(prefix + suf, stripped + suf)
     with pytest.raises(ValueError, match="graph_json is required"):
-        load_tensorflow_model(prefix, "features", "x:0", "out:0")
+        load_tensorflow_model(stripped, "features", "x:0", "out:0")
 
 
 def test_load_tensorflow_model_shape_mismatch_message(tf1_checkpoint):

@@ -1,0 +1,291 @@
+"""TF1 MetaGraphDef JSON executed directly (the reference's wire format).
+
+Fixtures are REAL metagraphs: built with tf.compat.v1, exported via
+``json_format.MessageToJson(export_meta_graph())`` — byte-for-byte the
+reference's ``build_graph`` output format (``sparkflow/graph_utils.py:6-15``)
+— then trained/served here with no TensorFlow in the execution path.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from sparkflow_tpu.models import model_from_json  # noqa: E402
+from sparkflow_tpu.tf1_compat import TF1GraphModel, is_tf1_metagraph  # noqa: E402
+from sparkflow_tpu.trainer import Trainer  # noqa: E402
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+
+def _dense(x, units, name, act=None):
+    with tf1.variable_scope(name):
+        k = tf1.get_variable("kernel", [int(x.shape[-1]), units],
+                             initializer=tf1.glorot_uniform_initializer())
+        b = tf1.get_variable("bias", [units],
+                             initializer=tf1.zeros_initializer())
+    y = tf1.nn.bias_add(tf1.matmul(x, k), b)
+    return act(y) if act else y
+
+
+def _export(build):
+    from google.protobuf import json_format
+    g = tf1.Graph()
+    with g.as_default():
+        build()
+        return json_format.MessageToJson(tf1.train.export_meta_graph()), g
+
+
+@pytest.fixture(scope="module")
+def mlp_metagraph():
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 2], name="x")
+        y = tf1.placeholder(tf.float32, [None, 1], name="y")
+        h = _dense(x, 12, "d1", tf.nn.relu)
+        out = tf1.sigmoid(_dense(h, 1, "outer"), name="out_act")
+        tf1.losses.log_loss(y, out)
+    return _export(build)[0]
+
+
+@pytest.fixture(scope="module")
+def softmax_metagraph():
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf1.placeholder(tf.float32, [None, 3], name="y")
+        h = _dense(x, 16, "h1", tf.nn.relu)
+        logits = _dense(h, 3, "logits")
+        tf1.nn.softmax(logits, name="probs")
+        tf1.losses.softmax_cross_entropy(y, logits)
+    return _export(build)[0]
+
+
+def test_sniffer_and_dispatch(mlp_metagraph):
+    assert is_tf1_metagraph(mlp_metagraph)
+    assert not is_tf1_metagraph('{"format": "other"}')
+    assert isinstance(model_from_json(mlp_metagraph), TF1GraphModel)
+
+
+def test_forward_matches_tf_session(mlp_metagraph):
+    """Same weights -> bitwise-close outputs vs a real tf.Session."""
+    from google.protobuf import json_format
+    from sparkflow_tpu.graphdef import list_to_params
+
+    mg = tf1.train.import_meta_graph  # noqa: F841 (documentation only)
+    g = tf1.Graph()
+    with g.as_default():
+        tf1.train.import_meta_graph(
+            json_format.Parse(mlp_metagraph, tf1.MetaGraphDef()))
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            w = sess.run(tf1.trainable_variables())
+            X = np.random.RandomState(0).rand(8, 2).astype(np.float32)
+            tf_out = sess.run("out_act:0", {"x:0": X})
+
+    m = model_from_json(mlp_metagraph)
+    params = list_to_params(m, w)  # flat order == tf.trainable_variables
+    out = np.asarray(m.apply(params, {"x": X}, ["out_act:0"])["out_act:0"])
+    np.testing.assert_allclose(out, tf_out, atol=1e-6)
+
+
+def test_trainer_fits_raw_metagraph(mlp_metagraph):
+    rs = np.random.RandomState(0)
+    X = np.concatenate([rs.normal(2, 1, (100, 2)),
+                        rs.normal(-2, 1, (100, 2))]).astype(np.float32)
+    Y = np.concatenate([np.ones(100), np.zeros(100)]).astype(np.float32)
+    tr = Trainer(mlp_metagraph, "x:0", "y:0", optimizer="adam",
+                 learning_rate=0.1, iters=30, mini_batch_size=64)
+    res = tr.fit(X, Y)
+    assert res.losses[-1] < res.losses[0]
+    from sparkflow_tpu.core import predict_in_chunks
+    preds = predict_in_chunks(tr.predict_fn("out_act:0"), res.params, X)
+    assert (((preds[:, 0] > 0.5) == (Y > 0.5)).mean()) > 0.9
+
+
+def test_fused_softmax_ce_trains(softmax_metagraph):
+    rs = np.random.RandomState(1)
+    X = rs.randn(150, 4).astype(np.float32)
+    lbl = X.argmax(1) % 3
+    Y = np.eye(3, dtype=np.float32)[lbl]
+    tr = Trainer(softmax_metagraph, "x:0", "y:0", optimizer="adam",
+                 learning_rate=0.05, iters=40, mini_batch_size=64)
+    res = tr.fit(X, Y)
+    assert res.losses[-1] < res.losses[0] * 0.7
+    from sparkflow_tpu.core import predict_in_chunks
+    preds = predict_in_chunks(tr.predict_fn("probs:0"), res.params, X)
+    assert (preds.argmax(1) == lbl).mean() > 0.6
+
+
+def test_estimator_accepts_reference_wire_format(mlp_metagraph):
+    """SparkAsyncDL(tensorflowGraph=<MetaGraphDef JSON>) — the reference's
+    exact usage — fit AND transform, no DSL rewrite."""
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL
+
+    spark = LocalSession.builder.getOrCreate()
+    rs = np.random.RandomState(12345)
+    rows = []
+    for _ in range(100):
+        rows.append((1.0, Vectors.dense(rs.normal(2, 1, 2))))
+        rows.append((0.0, Vectors.dense(rs.normal(-2, 1, 2))))
+    df = spark.createDataFrame(rows, ["label", "features"])
+    est = SparkAsyncDL(inputCol="features", tensorflowGraph=mlp_metagraph,
+                       tfInput="x:0", tfLabel="y:0", tfOutput="out_act:0",
+                       tfOptimizer="adam", tfLearningRate=0.1, iters=25,
+                       partitions=2, labelCol="label",
+                       predictionCol="predicted", miniBatchSize=64)
+    model = est.fit(df)
+    errs = sum(1 for r in model.transform(df).collect()
+               if round(float(r["predicted"])) != float(r["label"]))
+    assert errs < 40  # clearly separable gaussians
+
+
+def test_metagraph_init_uses_graph_initializers(mlp_metagraph):
+    import jax
+    m = model_from_json(mlp_metagraph)
+    p = m.init(jax.random.PRNGKey(0))
+    # glorot kernels: nonzero, bounded; zeros biases
+    k = np.asarray(p["d1"]["kernel"])
+    assert np.abs(k).max() > 0 and np.abs(k).max() < 2.0
+    np.testing.assert_array_equal(np.asarray(p["d1"]["bias"]), np.zeros(12))
+
+
+def test_unsupported_op_fails_with_op_name():
+    fake = {"graphDef": {"node": [
+        {"name": "x", "op": "Placeholder",
+         "attr": {"dtype": {"type": "DT_FLOAT"},
+                  "shape": {"shape": {"dim": [{"size": "-1"}]}}}},
+        {"name": "w", "op": "SparseSegmentMean", "input": ["x"]},
+    ]}}
+    m = TF1GraphModel(json.dumps(fake))
+    with pytest.raises(NotImplementedError, match="SparseSegmentMean"):
+        m.apply({}, {"x": np.zeros((2,), np.float32)}, ["w:0"])
+
+
+def test_cnn_metagraph_trains():
+    """Conv2D/MaxPool/Reshape path — the reference's cnn_example.py shape."""
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 64], name="x")
+        y = tf1.placeholder(tf.float32, [None, 2], name="y")
+        xr = tf1.reshape(x, [-1, 8, 8, 1])
+        with tf1.variable_scope("c1"):
+            k = tf1.get_variable("kernel", [3, 3, 1, 4],
+                                 initializer=tf1.glorot_uniform_initializer())
+            b = tf1.get_variable("bias", [4],
+                                 initializer=tf1.zeros_initializer())
+        c = tf.nn.relu(tf1.nn.bias_add(
+            tf1.nn.conv2d(xr, k, strides=[1, 1, 1, 1], padding="SAME"), b))
+        p = tf1.nn.max_pool(c, ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+                            padding="VALID")
+        flat = tf1.reshape(p, [-1, 4 * 4 * 4])
+        logits = _dense(flat, 2, "out")
+        tf1.nn.softmax(logits, name="probs")
+        tf1.losses.softmax_cross_entropy(y, logits)
+
+    mg, _ = _export(build)
+    rs = np.random.RandomState(0)
+    X = rs.rand(120, 64).astype(np.float32)
+    lbl = (X[:, :32].sum(1) > X[:, 32:].sum(1)).astype(int)
+    Y = np.eye(2, dtype=np.float32)[lbl]
+    tr = Trainer(mg, "x:0", "y:0", optimizer="adam", learning_rate=0.02,
+                 iters=30, mini_batch_size=32)
+    res = tr.fit(X, Y)
+    assert res.losses[-1] < res.losses[0]
+    from sparkflow_tpu.core import predict_in_chunks
+    preds = predict_in_chunks(tr.predict_fn("probs:0"), res.params, X)
+    assert (preds.argmax(1) == lbl).mean() > 0.7
+
+
+def test_load_tensorflow_model_full_reference_flow(tmp_path):
+    """The reference's exact usage (README.md:196-205): a Saver checkpoint
+    directory, no rebuilt graph — the .meta MetaGraphDef becomes the serving
+    graph and the checkpoint weights load by name."""
+    from sparkflow_tpu.model_loader import load_tensorflow_model
+
+    prefix = str(tmp_path / "to_load")
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, [None, 4], name="x")
+        h = _dense(x, 5, "d1", tf.nn.relu)
+        out = tf1.sigmoid(_dense(h, 1, "outer"), name="out_act")
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            tf1.train.Saver().save(sess, prefix)  # writes .meta too
+            X = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+            tf_out = sess.run("out_act:0", {"x:0": X})
+
+    model = load_tensorflow_model(prefix, "features", "x:0", "out_act:0")
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    spark = LocalSession.builder.getOrCreate()
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in X], ["features"])
+    preds = np.asarray([float(r["predicted"])
+                        for r in model.transform(df).collect()])
+    np.testing.assert_allclose(preds, tf_out[:, 0], atol=1e-5)
+
+
+REF_FIXTURE = "/root/reference/tests/test_model/to_load"
+
+
+@pytest.mark.skipif(not __import__("os").path.exists(REF_FIXTURE + ".meta"),
+                    reason="reference fixture not mounted")
+def test_reference_tf110_fixture_loads_and_serves():
+    """The reference repo's committed TF 1.10 Saver checkpoint
+    (tests/test_model/, README.md:196-205 usage) — saved by real TF 1.10 —
+    imports and serves through the interpreter with no graph rebuild."""
+    from sparkflow_tpu.model_loader import load_tensorflow_model
+
+    model = load_tensorflow_model(REF_FIXTURE, "features", "x:0",
+                                  "out/Sigmoid:0")
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    spark = LocalSession.builder.getOrCreate()
+    X = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in X], ["features"])
+    preds = [float(r["predicted"]) for r in model.transform(df).collect()]
+    assert len(preds) == 5 and all(0.0 <= p <= 1.0 for p in preds)
+
+
+def test_interleaved_scopes_keep_flat_order():
+    """Variables created with reopened/interleaved scopes must still load by
+    the trainable-collection flat order (grouping falls back to per-variable
+    layers)."""
+    from sparkflow_tpu.graphdef import list_to_params, params_to_list
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 2], name="x")
+        y = tf1.placeholder(tf.float32, [None, 1], name="y")
+        with tf1.variable_scope("a"):
+            k1 = tf1.get_variable("kernel", [2, 3],
+                                  initializer=tf1.ones_initializer())
+        with tf1.variable_scope("b"):
+            k2 = tf1.get_variable("kernel", [3, 1],
+                                  initializer=tf1.ones_initializer())
+        with tf1.variable_scope("a", reuse=False, auxiliary_name_scope=False):
+            b1 = tf1.get_variable("bias", [3],
+                                  initializer=tf1.zeros_initializer())
+        out = tf1.matmul(tf.nn.relu(tf1.matmul(x, k1) + b1), k2)
+        tf1.losses.mean_squared_error(y, out)
+
+    mg, _ = _export(build)
+    m = model_from_json(mg)
+    # creation order a/kernel, b/kernel, a/bias interleaves scope 'a'
+    w = [np.full((2, 3), 1.0, np.float32), np.full((3, 1), 2.0, np.float32),
+         np.full((3,), 3.0, np.float32)]
+    params = list_to_params(m, w)  # shapes must land on the right slots
+    back = params_to_list(m, params)
+    for a, b in zip(back, w):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_nchw_rejected_loudly():
+    fake = {"graphDef": {"node": [
+        {"name": "x", "op": "Placeholder",
+         "attr": {"dtype": {"type": "DT_FLOAT"},
+                  "shape": {"shape": {"dim": [{"size": "-1"}]}}}},
+        {"name": "c", "op": "BiasAdd", "input": ["x", "x"],
+         "attr": {"data_format": {"s": "TkNIVw=="}}},  # base64("NCHW")
+    ]}}
+    m = TF1GraphModel(json.dumps(fake))
+    with pytest.raises(NotImplementedError, match="NCHW"):
+        m.apply({}, {"x": np.zeros((2,), np.float32)}, ["c:0"])
