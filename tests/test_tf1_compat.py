@@ -289,3 +289,34 @@ def test_nchw_rejected_loudly():
     m = TF1GraphModel(json.dumps(fake))
     with pytest.raises(NotImplementedError, match="NCHW"):
         m.apply({}, {"x": np.zeros((2,), np.float32)}, ["c:0"])
+
+
+def test_dropout_placeholder_with_default():
+    """Reference dropout pattern: keep-prob placeholder_with_default; unfed
+    at train time (default applies), fed 1.0 at predict time."""
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 6], name="x")
+        y = tf1.placeholder(tf.float32, [None, 1], name="y")
+        keep = tf1.placeholder_with_default(tf.constant(0.5), [], name="keep")
+        h = _dense(x, 16, "d1", tf.nn.relu)
+        hd = tf1.nn.dropout(h, rate=1.0 - keep)
+        out = tf1.sigmoid(_dense(hd, 1, "outer"), name="out_act")
+        tf1.losses.log_loss(y, out)
+
+    mg, _ = _export(build)
+    m = model_from_json(mg)
+    import jax
+    params = m.init(jax.random.PRNGKey(0))
+    X = np.random.RandomState(0).rand(10, 6).astype(np.float32)
+    # fed keep=1.0 -> deterministic; two calls agree
+    a = np.asarray(m.apply(params, {"x": X, "keep": np.float32(1.0)},
+                           ["out_act:0"], rng=jax.random.PRNGKey(1))["out_act:0"])
+    b = np.asarray(m.apply(params, {"x": X, "keep": np.float32(1.0)},
+                           ["out_act:0"], rng=jax.random.PRNGKey(2))["out_act:0"])
+    np.testing.assert_allclose(a, b, atol=1e-7)
+    # unfed -> default 0.5 keep: stochastic masking changes with the rng
+    c = np.asarray(m.apply(params, {"x": X}, ["out_act:0"],
+                           rng=jax.random.PRNGKey(1))["out_act:0"])
+    d = np.asarray(m.apply(params, {"x": X}, ["out_act:0"],
+                           rng=jax.random.PRNGKey(2))["out_act:0"])
+    assert np.abs(c - d).max() > 1e-6
