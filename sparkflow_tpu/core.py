@@ -63,20 +63,30 @@ def make_loss_fn(model: GraphModel, input_name,
     where workers feed only input+label while training
     (``sparkflow/ml_util.py:109-118``) and the dropout feed exists only on the
     predict path (``sparkflow/ml_util.py:70-71``)."""
+    build_feeds = make_feeds_builder(input_name, label_name)
+
+    def loss_fn(params, x, y, mask, rng):
+        lv = model.loss_vector(params, build_feeds(x, y), train=True, rng=rng)
+        return _masked_mean(lv, mask)
+
+    return loss_fn
+
+
+def make_feeds_builder(input_name, label_name: Optional[str]) -> Callable:
+    """``(x, y) -> feeds dict`` shared by every step builder: strips ``:0``
+    suffixes, zips multi-input tuples, omits the label when unsupervised."""
     multi = isinstance(input_name, (list, tuple))
     in_keys = ([n.split(":")[0] for n in input_name] if multi
                else [input_name.split(":")[0]])
     lbl_key = label_name.split(":")[0] if label_name else None
 
-    def loss_fn(params, x, y, mask, rng):
-        xs = tuple(x) if multi else (x,)
-        feeds = dict(zip(in_keys, xs))
+    def build_feeds(x, y):
+        feeds = dict(zip(in_keys, tuple(x) if multi else (x,)))
         if lbl_key is not None:
             feeds[lbl_key] = y
-        lv = model.loss_vector(params, feeds, train=True, rng=rng)
-        return _masked_mean(lv, mask)
+        return feeds
 
-    return loss_fn
+    return build_feeds
 
 
 def _step_body(loss_fn: Callable, optimizer: optax.GradientTransformation) -> Callable:

@@ -19,7 +19,9 @@ no parameter server process.
 
 from .mesh import default_mesh, make_mesh, mesh_axis_size
 from . import collectives
+from .dp import make_dp_shardmap_train_step
 from .hyper import HyperResult, hyperparameter_search
 
 __all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives",
-           "HyperResult", "hyperparameter_search"]
+           "make_dp_shardmap_train_step", "HyperResult",
+           "hyperparameter_search"]

@@ -1,0 +1,62 @@
+"""Data-parallel train step via shard_map: the pallas-kernel multi-chip path.
+
+``core.make_train_step``'s GSPMD jit must route attention to the blockwise
+XLA path because ``pallas_call`` has no SPMD partitioning rule
+(``ops.attention.force_xla_attention``). Inside :func:`jax.shard_map` every
+operand is the device-LOCAL shard, so the flash-attention kernels run
+per-device with no partitioner involved — this is the standard recipe for
+custom kernels on a mesh (scaling-book §sharding: map the kernel, let the
+collectives handle the rest).
+
+Semantics are identical to the GSPMD step: the loss is the global masked
+mean, gradients are ``psum``-reduced sums divided by the global example
+count, and the optax update runs replicated (identical on every device).
+Dropout rngs fold in the device index so shards draw independent masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_dp_shardmap_train_step(model, optimizer, mesh: Mesh,
+                                input_name, label_name: Optional[str],
+                                dp_axis: str = "dp"):
+    """Jitted train step with the model body under shard_map over ``dp_axis``.
+
+    Signature matches ``core.make_train_step``'s:
+    ``step(params, opt_state, x, y, mask, rng) -> (params, opt_state, loss)``
+    with x/y/mask sharded over ``dp_axis`` (row counts must divide the axis
+    size) and params/opt_state replicated.
+    """
+    from ..core import make_feeds_builder
+    build_feeds = make_feeds_builder(input_name, label_name)
+    data_spec = P(dp_axis)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), P(), data_spec, data_spec, data_spec, P()),
+             out_specs=(P(), P(), P()),
+             check_vma=False)
+    def step(params, opt_state, x, y, mask, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(dp_axis))
+
+        def local_sum(p):
+            lv = model.loss_vector(p, build_feeds(x, y), train=True, rng=rng)
+            return jnp.sum(lv * mask)
+
+        s, grads = jax.value_and_grad(local_sum)(params)
+        n = jnp.maximum(jax.lax.psum(jnp.sum(mask), dp_axis), 1.0)
+        loss = jax.lax.psum(s, dp_axis) / n
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, dp_axis) / n, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
