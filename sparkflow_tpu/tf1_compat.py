@@ -571,6 +571,16 @@ class _Evaluator:
             out = np.asarray(x)[tuple(idx)] if _is_static(x) \
                 else jnp.asarray(x)[tuple(idx)]
             return out
+        if op == "L2Loss":
+            x = jnp.asarray(self._in(node, 0))
+            return jnp.sum(jnp.square(x)) / 2.0
+        if op in ("Pad", "PadV2"):
+            x = jnp.asarray(self._in(node, 0))
+            paddings = [(int(a), int(b))
+                        for a, b in np.asarray(self._in(node, 1))]
+            cval = (float(np.asarray(self._in(node, 2)))
+                    if op == "PadV2" else 0.0)
+            return jnp.pad(x, paddings, constant_values=cval)
         if op == "Slice":
             x = self._in(node, 0)
             begin = [int(b) for b in np.asarray(self._in(node, 1)).reshape(-1)]

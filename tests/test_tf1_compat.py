@@ -320,3 +320,30 @@ def test_dropout_placeholder_with_default():
     d = np.asarray(m.apply(params, {"x": X}, ["out_act:0"],
                            rng=jax.random.PRNGKey(2))["out_act:0"])
     assert np.abs(c - d).max() > 1e-6
+
+
+def test_l2loss_and_pad_ops():
+    """Weight decay (tf.nn.l2_loss) and tf.pad — reference-era staples."""
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 3], name="x")
+        y = tf1.placeholder(tf.float32, [None, 1], name="y")
+        with tf1.variable_scope("d"):
+            k = tf1.get_variable("kernel", [5, 1],
+                                 initializer=tf1.ones_initializer())
+        xp = tf1.pad(x, [[0, 0], [1, 1]])  # [None, 5]
+        out = tf1.matmul(xp, k, name="out")
+        loss = tf1.losses.mean_squared_error(y, out)
+        tf1.add_to_collection(tf1.GraphKeys.LOSSES,
+                              1e-3 * tf.nn.l2_loss(k))
+
+    mg, _ = _export(build)
+    m = model_from_json(mg)
+    import jax
+    params = m.init(jax.random.PRNGKey(0))
+    X = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+    out = np.asarray(m.apply(params, {"x": X}, ["out:0"])["out:0"])
+    # pad adds zero columns on both sides; kernel all-ones -> row sums
+    np.testing.assert_allclose(out[:, 0], X.sum(1), rtol=1e-6)
+    lv = m.loss_vector(params, {"x": X, "y": np.zeros((4, 1), np.float32)},
+                       train=False)
+    assert lv.shape == (4,) and np.isfinite(np.asarray(lv)).all()
