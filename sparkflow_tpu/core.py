@@ -250,22 +250,45 @@ def pad_to_batches(x: np.ndarray, batch_size: int,
 
 def make_predict_fn(model: GraphModel, input_name, output_name: str,
                     dropout_name: Optional[str] = None,
-                    dropout_value: float = 1.0) -> Callable:
+                    dropout_value: float = 1.0,
+                    mesh: Optional[Mesh] = None) -> Callable:
     """Jitted fixed-shape inference: ``predict(params, x) -> out``.
-    ``input_name`` may be a sequence of names; ``x`` is then a tuple."""
+    ``input_name`` may be a sequence of names; ``x`` is then a tuple.
+    With ``mesh``, the batch shards over 'dp'; arbitrary batch sizes are
+    padded to the axis size internally and trimmed on return."""
     multi = isinstance(input_name, (list, tuple))
     in_keys = ([n.split(":")[0] for n in input_name] if multi
                else [input_name.split(":")[0]])
     drop_key = dropout_name.split(":")[0] if dropout_name else None
 
-    @jax.jit
     def predict(params, x):
         feeds = dict(zip(in_keys, tuple(x) if multi else (x,)))
         if drop_key is not None:
             feeds[drop_key] = jnp.asarray(dropout_value, jnp.float32)
         return model.apply(params, feeds, [output_name], train=False)[output_name]
 
-    return predict
+    if mesh is None or mesh.size <= 1:
+        return jax.jit(predict)
+    predict = _sharded_trace_guard(predict, mesh)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    inner = jax.jit(predict, in_shardings=(repl, data), out_shardings=data)
+    dp = mesh.shape["dp"]
+
+    def padded_predict(params, x):
+        # shard divisibility is handled HERE, not by callers: any batch size
+        # (probes of 1, ragged tails, empty) pads up to a dp multiple and
+        # trims after — predict_in_chunks needs no mesh awareness
+        xs = tuple(x) if multi else (x,)
+        n = xs[0].shape[0]
+        pad = (-n) % dp
+        if pad:
+            xs = tuple(jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]) for a in xs)
+        out = inner(params, xs if multi else xs[0])
+        return out[:n]
+
+    return padded_predict
 
 
 def predict_in_chunks(predict_fn: Callable, params, x,

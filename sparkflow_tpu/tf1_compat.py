@@ -335,6 +335,12 @@ class _Evaluator:
         self.rng, sub = jax.random.split(self.rng)
         return sub
 
+    def _compute_cast(self, x):
+        """MXU-feeding operands honor the model's compute_dtype (bf16 on
+        TPU, one policy: TF1GraphModel.cast); accumulation stays f32 via
+        preferred_element_type."""
+        return self.m.cast(jnp.asarray(x))
+
     # -- op table ------------------------------------------------------------
 
     def _eval(self, node):  # noqa: C901 — one dispatch table, kept flat
@@ -436,12 +442,14 @@ class _Evaluator:
                     f"TF1 op {op!r} with data_format={_b64str(fmt)!r} "
                     f"(node {node['name']!r}): only NHWC is supported")
         if op == "MatMul":
-            a, b = jnp.asarray(self._in(node, 0)), jnp.asarray(self._in(node, 1))
+            a, b = (self._compute_cast(self._in(node, 0)),
+                    self._compute_cast(self._in(node, 1)))
             if attr.get("transpose_a", {}).get("b"):
                 a = a.T
             if attr.get("transpose_b", {}).get("b"):
                 b = b.T
-            return jnp.matmul(a, b)
+            # bf16 operands on the MXU, f32 accumulation
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32)
         if op == "BiasAdd":
             return jnp.asarray(self._in(node, 0)) + jnp.asarray(self._in(node, 1))
         if op == "Softmax":
@@ -456,12 +464,14 @@ class _Evaluator:
             grad = jax.nn.softmax(logits, axis=-1) - labels
             return (loss, grad)
         if op == "Conv2D":
-            x, k = jnp.asarray(self._in(node, 0)), jnp.asarray(self._in(node, 1))
+            x, k = (self._compute_cast(self._in(node, 0)),
+                    self._compute_cast(self._in(node, 1)))
             strides = [int(s) for s in attr["strides"]["list"]["i"]]
             padding = _b64str(attr["padding"]["s"])
             return jax.lax.conv_general_dilated(
                 x, k, window_strides=strides[1:3], padding=padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)
         if op == "MaxPool":
             x = jnp.asarray(self._in(node, 0))
             ks = [int(s) for s in attr["ksize"]["list"]["i"]]

@@ -488,6 +488,9 @@ class Trainer:
             raise RuntimeError("fit() has not been run")
         return params_to_list(self.model, self.params)
 
-    def predict_fn(self, output_name: str, dropout_value: float = 1.0) -> Callable:
+    def predict_fn(self, output_name: str, dropout_value: float = 1.0,
+                   mesh=None) -> Callable:
+        """``mesh=`` opts into dp-sharded batch inference (chunk sizes must
+        divide the dp axis); default stays single-device."""
         return make_predict_fn(self.model, self.input_name, output_name,
-                               self.dropout_name, dropout_value)
+                               self.dropout_name, dropout_value, mesh=mesh)

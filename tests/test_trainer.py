@@ -318,3 +318,29 @@ def test_trainer_multi_input_tuple_features():
     preds = predict_in_chunks(tr.predict_fn("pred:0"), res.params,
                               (ids, mask))
     assert ((preds > 0.5) == lbl).mean() > 0.6
+
+
+def test_mesh_sharded_predict(data, dp_mesh):
+    X, Y, lbl = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=10,
+                 mini_batch_size=64, mesh=dp_mesh)
+    res = tr.fit(X, Y)
+    single = predict_in_chunks(tr.predict_fn("out:0"), res.params, X)
+    sharded = predict_in_chunks(tr.predict_fn("out:0", mesh=dp_mesh),
+                                res.params, X, chunk_size=64)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_sharded_predict_ragged_and_empty(data, dp_mesh):
+    """Mesh predict pads internally: batch sizes that don't divide dp (and
+    empty inputs) just work."""
+    X, Y, _ = data
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=3,
+                 mini_batch_size=64, mesh=dp_mesh)
+    res = tr.fit(X, Y)
+    fn = tr.predict_fn("out:0", mesh=dp_mesh)
+    ragged = predict_in_chunks(fn, res.params, X[:5], chunk_size=64)
+    assert ragged.shape == (5, 2)
+    empty = predict_in_chunks(fn, res.params, np.zeros((0, 10), np.float32))
+    assert empty.shape == (0, 2)
