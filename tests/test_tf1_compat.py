@@ -376,3 +376,62 @@ def test_metagraph_bf16_compute_dtype(mlp_metagraph):
     # bf16 matmul operands, f32 accumulation: close but not identical
     np.testing.assert_allclose(a, b, atol=2e-2)
     assert np.abs(a - b).max() > 0  # the cast actually happened
+
+
+def test_differential_fuzz_vs_tf_session():
+    """Differential testing: random small graphs (random depths, widths,
+    activations, losses) must match a live tf.Session forward + loss."""
+    from google.protobuf import json_format
+    from sparkflow_tpu.graphdef import list_to_params
+
+    acts = [None, tf.nn.relu, tf.nn.sigmoid, tf.nn.tanh, tf.nn.softplus]
+    rs = np.random.RandomState(42)
+    for trial in range(5):
+        depth = rs.randint(1, 4)
+        widths = [int(w) for w in rs.randint(2, 9, depth)]
+        in_dim = int(rs.randint(2, 6))
+        loss_kind = ["mse", "log", "softmax"][trial % 3]
+        out_dim = widths[-1] if loss_kind != "log" else 1
+
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, in_dim], name="x")
+            y = tf1.placeholder(tf.float32, [None, out_dim], name="y")
+            h = x
+            for li, w in enumerate(widths[:-1]):
+                h = _dense(h, w, f"l{li}", acts[rs.randint(len(acts))])
+            if loss_kind == "mse":
+                out = _dense(h, out_dim, "out")
+                tf1.losses.mean_squared_error(y, out)
+                out_name = out.name
+            elif loss_kind == "log":
+                out = tf1.sigmoid(_dense(h, 1, "out"), name="oact")
+                tf1.losses.log_loss(y, out)
+                out_name = "oact:0"
+            else:
+                logits = _dense(h, out_dim, "out")
+                tf1.nn.softmax(logits, name="probs")
+                tf1.losses.softmax_cross_entropy(y, logits)
+                out_name = "probs:0"
+            mg = json_format.MessageToJson(tf1.train.export_meta_graph())
+            with tf1.Session(graph=g) as sess:
+                sess.run(tf1.global_variables_initializer())
+                w = sess.run(tf1.trainable_variables())
+                X = rs.rand(7, in_dim).astype(np.float32)
+                if loss_kind == "softmax":
+                    Y = np.eye(out_dim, dtype=np.float32)[
+                        rs.randint(0, out_dim, 7)]
+                else:
+                    Y = rs.rand(7, out_dim).astype(np.float32)
+                tf_out = sess.run(out_name, {"x:0": X})
+                loss_name = tf1.get_collection(tf1.GraphKeys.LOSSES)[0].name
+                tf_loss = sess.run(loss_name, {"x:0": X, "y:0": Y})
+
+        m = model_from_json(mg)
+        params = list_to_params(m, w)
+        out = np.asarray(m.apply(params, {"x": X}, [out_name])[out_name])
+        np.testing.assert_allclose(out, tf_out, atol=1e-5,
+                                   err_msg=f"trial {trial} ({loss_kind})")
+        lv = np.asarray(m.loss_vector(params, {"x": X, "y": Y}, train=False))
+        np.testing.assert_allclose(lv.mean(), float(tf_loss), rtol=1e-4,
+                                   err_msg=f"trial {trial} loss ({loss_kind})")
