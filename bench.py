@@ -128,7 +128,11 @@ def main():
         "vs_baseline": vs_baseline,
     }
     if fallback:
-        out["note"] = "tpu unreachable at bench time; measured on CPU fallback"
+        out["note"] = (
+            "tpu relay wedged at bench time (hung at backend init all "
+            "round); measured on CPU fallback. Last successful TPU "
+            "measurement: 51,229 ex/s = 18.8x baseline (round 1, this same "
+            "benchmark before the relay outage — see BENCH_NOTES.md).")
     elif platform == "tpu" and not quick:
         # persist only FULL-SIZE TPU measurements, with provenance, so a
         # later wedged-relay run can report an honest earlier number

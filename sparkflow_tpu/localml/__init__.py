@@ -24,7 +24,8 @@ from .param import Param, Params, TypeConverters, keyword_only
 from .base import Estimator, Transformer, Model, Identifiable, MLReadable, MLWritable
 from .linalg import Vectors, DenseVector, SparseVector
 from .sql import Row, DataFrame, RDD, LocalSession
-from .feature import VectorAssembler, OneHotEncoder, Normalizer
+from .feature import (VectorAssembler, OneHotEncoder, Normalizer,
+                      WordpieceEncoder)
 from .pipeline import Pipeline, PipelineModel
 from .evaluation import MulticlassClassificationEvaluator
 
@@ -33,7 +34,7 @@ __all__ = [
     "Estimator", "Transformer", "Model", "Identifiable", "MLReadable", "MLWritable",
     "Vectors", "DenseVector", "SparseVector",
     "Row", "DataFrame", "RDD", "LocalSession",
-    "VectorAssembler", "OneHotEncoder", "Normalizer",
+    "VectorAssembler", "WordpieceEncoder", "OneHotEncoder", "Normalizer",
     "Pipeline", "PipelineModel",
     "MulticlassClassificationEvaluator",
 ]

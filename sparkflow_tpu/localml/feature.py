@@ -107,3 +107,54 @@ class Normalizer(Transformer, HasInputCol, HasOutputCol):
             rows.append(Row(**{**r.asDict(), out_col: vec}))
         cols = dataset.columns + ([out_col] if out_col not in dataset.columns else [])
         return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class WordpieceEncoder(Transformer, HasInputCol, HasOutputCol):
+    """Text column -> fixed-shape token-id vector + attention-mask columns,
+    ready for ``SparkAsyncDL`` transformer models
+    (``extraInputCols=maskCol``). Backed by the native C++ WordPiece
+    tokenizer (``sparkflow_tpu/native/tokenizer.cpp``); python fallback
+    otherwise. No pyspark analog exists — a capability upgrade over the
+    reference, which has no text front-end at all (SURVEY.md §5)."""
+
+    maskCol = Param(Params._dummy(), "maskCol", "attention mask column",
+                    typeConverter=TypeConverters.toString)
+    maxLen = Param(Params._dummy(), "maxLen", "sequence length",
+                   typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, maskCol=None,
+                 maxLen=None, vocab=None):
+        super().__init__()
+        self._setDefault(maskCol="mask", maxLen=128)
+        self._vocab = list(vocab) if vocab is not None else None
+        kwargs = dict(self._input_kwargs)
+        kwargs.pop("vocab", None)
+        self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def setVocab(self, vocab) -> "WordpieceEncoder":
+        self._vocab = list(vocab)
+        return self
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        from ..utils.text import WordpieceTokenizer, build_vocab
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        mask_col = self.getOrDefault(self.maskCol)
+        max_len = self.getOrDefault(self.maxLen)
+        rows = dataset.collect()
+        texts = [str(r[in_col]) for r in rows]
+        vocab = self._vocab
+        if vocab is None:  # fit-free convenience: derive from this dataset
+            vocab = build_vocab(texts)
+            self._vocab = vocab
+        tok = WordpieceTokenizer(vocab)
+        ids, mask = tok.encode_batch(texts, max_len)
+        out = []
+        for r, i, m_ in zip(rows, ids, mask):
+            out.append(Row(**{**r.asDict(),
+                              out_col: Vectors.dense(i.astype(float)),
+                              mask_col: Vectors.dense(m_.astype(float))}))
+        cols = dataset.columns + [c for c in (out_col, mask_col)
+                                  if c not in dataset.columns]
+        return DataFrame(out, cols, dataset.num_partitions)

@@ -19,7 +19,9 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "dataplane.cpp")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = [os.path.join(_HERE, "dataplane.cpp"),
+            os.path.join(_HERE, "tokenizer.cpp")]
 
 
 def _cache_dir() -> str:
@@ -31,9 +33,11 @@ def _cache_dir() -> str:
 
 
 def _lib_path() -> str:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:12]
-    return os.path.join(_cache_dir(), f"libsfdata-{tag}.so")
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_cache_dir(), f"libsfdata-{h.hexdigest()[:12]}.so")
 
 
 def load_library(verbose: bool = False) -> Optional[ctypes.CDLL]:
@@ -51,7 +55,7 @@ def load_library(verbose: bool = False) -> Optional[ctypes.CDLL]:
             # must never dlopen a partially written .so
             tmp = f"{path}.tmp.{os.getpid()}"
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                   _SRC, "-o", tmp]
+                   *_SOURCES, "-o", tmp]
             try:
                 subprocess.run(cmd, check=True, capture_output=not verbose,
                                timeout=120)
@@ -95,3 +99,12 @@ def _configure(lib: ctypes.CDLL) -> None:
                                 ctypes.POINTER(i64)]
     lib.sf_free.restype = None
     lib.sf_free.argtypes = [ctypes.c_void_p]
+    # wordpiece tokenizer (tokenizer.cpp)
+    lib.sft_create.restype = ctypes.c_void_p
+    lib.sft_create.argtypes = [ctypes.c_char_p, i64, i64]
+    lib.sft_encode.restype = i64
+    lib.sft_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_int32), f32p, i64,
+                               ctypes.c_int32, ctypes.c_int32]
+    lib.sft_destroy.restype = None
+    lib.sft_destroy.argtypes = [ctypes.c_void_p]

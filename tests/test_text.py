@@ -1,0 +1,101 @@
+"""WordPiece tokenizer (native C++ + python fallback) and the text pipeline."""
+
+import numpy as np
+import pytest
+
+from sparkflow_tpu.utils.text import (WordpieceTokenizer, build_vocab,
+                                      _basic_split)
+
+VOCAB = ["[PAD]", "[UNK]", "the", "quick", "brown", "fox", "jump", "##ed",
+         "##s", "over", "lazy", "dog", ",", "."]
+
+
+def test_basic_split():
+    assert _basic_split("The quick, brown fox.") == [
+        "the", "quick", ",", "brown", "fox", "."]
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_wordpiece_greedy_longest_match(use_native):
+    tok = WordpieceTokenizer(VOCAB, use_native=use_native)
+    ids, mask = tok.encode("The quick fox jumped", max_len=8)
+    # jumped -> jump + ##ed
+    expect = [VOCAB.index(t) for t in ("the", "quick", "fox", "jump", "##ed")]
+    assert list(ids[:5]) == expect
+    assert list(mask) == [1, 1, 1, 1, 1, 0, 0, 0]
+    assert list(ids[5:]) == [0, 0, 0]  # PAD
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_wordpiece_unk_and_truncation(use_native):
+    tok = WordpieceTokenizer(VOCAB, use_native=use_native)
+    ids, mask = tok.encode("zebra the", max_len=2)
+    assert ids[0] == VOCAB.index("[UNK]")
+    assert ids[1] == VOCAB.index("the")
+    ids2, _ = tok.encode("the quick brown fox over lazy dog", max_len=3)
+    assert len(ids2) == 3  # truncated, fixed shape
+
+
+def test_native_matches_python_fallback():
+    texts = ["The quick brown fox jumps over the lazy dog.",
+             "jumped, jumps", "unknownword quick", "",
+             "\u00c9clair caf\u00e9 the",  # non-ASCII passes through both paths
+             "a\x01b the"]                   # control chars: no split either path
+    tn = WordpieceTokenizer(VOCAB, use_native=True)
+    tp = WordpieceTokenizer(VOCAB, use_native=False)
+    if tn._native is None:
+        pytest.skip("no C++ toolchain")
+    for t in texts:
+        a_ids, a_m = tn.encode(t, 16)
+        b_ids, b_m = tp.encode(t, 16)
+        np.testing.assert_array_equal(a_ids, b_ids, err_msg=t)
+        np.testing.assert_array_equal(a_m, b_m, err_msg=t)
+
+
+def test_build_vocab_frequency_order():
+    v = build_vocab(["a a a b b c"], max_size=5)
+    assert v[:2] == ["[PAD]", "[UNK]"] and v[2] == "a"
+
+
+def test_text_to_transformer_pipeline():
+    """Full text pipeline: WordpieceEncoder -> multi-input transformer
+    through the estimator (tokenize, mask, train, predict)."""
+    from sparkflow_tpu.localml import LocalSession, WordpieceEncoder
+    from sparkflow_tpu.models import build_registry_spec
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL
+
+    rs = np.random.RandomState(0)
+    pos_words = ["great", "good", "happy"]
+    neg_words = ["bad", "awful", "sad"]
+    filler = ["the", "movie", "was", "very", "so"]
+    rows = []
+    for _ in range(80):
+        label = rs.randint(0, 2)
+        words = [filler[i] for i in rs.randint(0, len(filler), 4)]
+        words.append((pos_words if label else neg_words)[rs.randint(0, 3)])
+        rows.append((float(label), " ".join(words)))
+    spark = LocalSession.builder.getOrCreate()
+    df = spark.createDataFrame(rows, ["label", "text"])
+
+    from sparkflow_tpu.localml import OneHotEncoder
+    enc = WordpieceEncoder(inputCol="text", outputCol="tokens",
+                           maskCol="mask", maxLen=8)
+    oh = OneHotEncoder(inputCol="label", outputCol="labels", dropLast=False)
+    encoded = oh.transform(enc.transform(df))
+    vocab_size = len(enc._vocab)
+    spec = build_registry_spec("transformer_classifier",
+                               vocab_size=vocab_size, num_classes=2,
+                               hidden=16, num_layers=1, num_heads=2,
+                               mlp_dim=32, max_len=8, dropout=0.0)
+    est = SparkAsyncDL(inputCol="tokens", tensorflowGraph=spec,
+                       tfInput="input_ids:0", tfLabel="y:0",
+                       tfOutput="pred:0", tfOptimizer="adam",
+                       tfLearningRate=0.01, iters=30, partitions=2,
+                       labelCol="labels", predictionCol="predicted",
+                       miniBatchSize=16,
+                       extraInputCols="mask",
+                       extraTfInputs="attention_mask:0")
+    model = est.fit(encoded)
+    errs = sum(1 for r in model.transform(encoded).collect()
+               if round(float(r["predicted"])) != float(r["label"]))
+    assert errs < 20, errs  # the sentiment marker token is fully separable
