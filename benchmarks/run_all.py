@@ -184,6 +184,37 @@ def bench_flash_attention():
            "xla_ms": round(trg * 1e3, 2)})
 
 
+def bench_tokenizer():
+    """Native C++ WordPiece vs the python fallback — measurable on any host
+    (no TPU involved): strings/sec on synthetic text."""
+    from sparkflow_tpu.utils.text import WordpieceTokenizer, build_vocab
+
+    rs = np.random.RandomState(0)
+    words = ["".join(chr(97 + c) for c in rs.randint(0, 26, rs.randint(2, 10)))
+             for _ in range(2000)]
+    texts = [" ".join(words[i] for i in rs.randint(0, len(words), 24))
+             for _ in range(500 if QUICK else 4000)]
+    vocab = build_vocab(texts, max_size=5000)
+
+    results = {}
+    for label, use_native in (("native", True), ("python", False)):
+        tok = WordpieceTokenizer(vocab, use_native=use_native)
+        if label == "native" and tok._native is None:
+            results[label] = None
+            continue
+        t0 = time.perf_counter()
+        tok.encode_batch(texts, 64)
+        results[label] = len(texts) / (time.perf_counter() - t0)
+    if results.get("native"):
+        _emit("wordpiece_tokenizer_native_vs_python",
+              results["native"] / results["python"], "speedup_x",
+              {"native_strings_per_sec": round(results["native"]),
+               "python_strings_per_sec": round(results["python"])})
+    else:
+        _emit("wordpiece_tokenizer_native_vs_python", 0, "speedup_x",
+              {"skipped": "no C++ toolchain"})
+
+
 def main():
     import os
     import sys as _sys
@@ -203,6 +234,7 @@ def main():
     bench_resnet(compute_dtype)
     bench_bert_step(compute_dtype)
     bench_flash_attention()
+    bench_tokenizer()
 
 
 if __name__ == "__main__":

@@ -106,5 +106,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.sft_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_int32), f32p, i64,
                                ctypes.c_int32, ctypes.c_int32]
+    lib.sft_encode_batch.restype = i64
+    lib.sft_encode_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
+                                     i64, ctypes.POINTER(ctypes.c_int32),
+                                     f32p, i64, ctypes.c_int32,
+                                     ctypes.c_int32]
     lib.sft_destroy.restype = None
     lib.sft_destroy.argtypes = [ctypes.c_void_p]

@@ -129,6 +129,28 @@ int64_t sft_encode(SfTokenizer* t, const char* text, int32_t* out_ids,
     return w;
 }
 
+// Whole-batch entry point: texts arrive as one '\n'-joined blob (texts must
+// not contain '\n'; the python binding strips them) and rows write straight
+// into the caller's [n, max_len] buffers — ONE ctypes crossing per batch.
+int64_t sft_encode_batch(SfTokenizer* t, const char* blob, int64_t blob_len,
+                         int64_t n, int32_t* out_ids, float* out_mask,
+                         int64_t max_len, int32_t unk_id, int32_t pad_id) {
+    int64_t row = 0;
+    const char* start = blob;
+    const char* end = blob + blob_len;
+    std::string tmp;
+    for (const char* p = blob; p <= end && row < n; ++p) {
+        if (p == end || *p == '\n') {
+            tmp.assign(start, (size_t)(p - start));
+            sft_encode(t, tmp.c_str(), out_ids + row * max_len,
+                       out_mask + row * max_len, max_len, unk_id, pad_id);
+            ++row;
+            start = p + 1;
+        }
+    }
+    return row;
+}
+
 void sft_destroy(SfTokenizer* t) { delete t; }
 
 }  // extern "C"

@@ -141,12 +141,22 @@ class WordpieceTokenizer:
     def encode_batch(self, texts: Sequence[str], max_len: int
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Strings -> (ids [n, max_len], mask [n, max_len]) fixed shapes;
-        rows are written in place (no per-row allocations)."""
+        one native call per batch (rows written in place)."""
         n = len(texts)
         ids = np.full((n, max_len), self.pad_id, np.int32)
         mask = np.zeros((n, max_len), np.float32)
-        for i, t in enumerate(texts):
-            self._encode_into(t, max_len, ids[i], mask[i])
+        if self._native is not None and n:
+            # newlines act as the row separator in the blob: normalize them
+            # to spaces (identical tokenization — both are whitespace)
+            blob = "\n".join(t.replace("\n", " ") for t in texts).encode("utf-8")
+            self._native.sft_encode_batch(
+                self._handle, blob, len(blob), n,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                max_len, self.unk_id, self.pad_id)
+        else:
+            for i, t in enumerate(texts):
+                self._encode_into(t, max_len, ids[i], mask[i])
         return ids, mask
 
     @classmethod

@@ -99,3 +99,14 @@ def test_text_to_transformer_pipeline():
     errs = sum(1 for r in model.transform(encoded).collect()
                if round(float(r["predicted"])) != float(r["label"]))
     assert errs < 20, errs  # the sentiment marker token is fully separable
+
+
+def test_encode_batch_matches_per_string():
+    texts = ["the quick fox", "jumped over,", "", "zebra zebra the",
+             "line\nbreak the"]
+    tok = WordpieceTokenizer(VOCAB)
+    bi, bm = tok.encode_batch(texts, 8)
+    for i, t in enumerate(texts):
+        si, sm = tok.encode(t.replace("\n", " "), 8)
+        np.testing.assert_array_equal(bi[i], si, err_msg=t)
+        np.testing.assert_array_equal(bm[i], sm, err_msg=t)
