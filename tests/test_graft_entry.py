@@ -22,3 +22,8 @@ def test_dryrun_multichip_8():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)  # raises on any non-finite loss or shard failure
+
+
+def test_dryrun_multiprocess_2():
+    import __graft_entry__ as ge
+    ge.dryrun_multiprocess(2)  # raises on any worker failure
