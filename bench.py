@@ -100,16 +100,18 @@ def main():
     # bf16 compute on TPU (f32 accumulation stays on); f32 elsewhere
     compute_dtype = "bfloat16" if platform == "tpu" else None
 
+    iters = 2 if quick else 6
     trainer = Trainer(mg, "x:0", "y:0", optimizer="adam",
                       optimizer_options={"learning_rate": 1e-3},
                       mini_batch_size=1024, shuffle_per_iter=True,
-                      iters=1, mesh=default_mesh(),
+                      iters=iters, mesh=default_mesh(),
                       compute_dtype=compute_dtype)
 
-    trainer.fit(x, y)  # warmup epoch: compile + stage data
+    # warmup fit compiles the SAME fused multi-epoch program the measured
+    # fit reuses (the whole fit is one device dispatch — see
+    # core.make_multi_epoch_fn); measured run starts from its params
+    trainer.fit(x, y)
 
-    iters = 2 if quick else 6
-    trainer.iters = iters
     res = trainer.fit(x, y, init_params=trainer.params)
     eps = res.examples_per_sec
 
@@ -131,7 +133,7 @@ def main():
         out["note"] = (
             "tpu relay wedged at bench time (hung at backend init all "
             "round); measured on CPU fallback. Last successful TPU "
-            "measurement: 51,229 ex/s = 18.8x baseline (round 1, this same "
+            "measurement: 51,229 ex/s = 17.8-18.8x baseline (round 1, this same "
             "benchmark before the relay outage — see BENCH_NOTES.md).")
     elif platform == "tpu" and not quick:
         # persist only FULL-SIZE TPU measurements, with provenance, so a

@@ -27,9 +27,8 @@ def _train_eps(graph, input_name, label_name, x, y, batch, epochs, **kw):
     from sparkflow_tpu.trainer import Trainer
 
     tr = Trainer(graph, input_name, label_name, optimizer="adam",
-                 mini_batch_size=batch, iters=1, **kw)
-    tr.fit(x, y)                      # warmup/compile epoch
-    tr.iters = epochs
+                 mini_batch_size=batch, iters=epochs, **kw)
+    tr.fit(x, y)  # warmup compiles the same fused multi-epoch program
     res = tr.fit(x, y, init_params=tr.params)
     return res.examples_per_sec
 

@@ -143,10 +143,27 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     )
 
 
+def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh]) -> Callable:
+    """Shared jit wrapper for epoch-shaped programs
+    ``fn(params, opt_state, data, labels, mask, rng)``."""
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(0, 1))
+    fn = _sharded_trace_guard(fn, mesh)
+    repl = NamedSharding(mesh, P())
+    rows = NamedSharding(mesh, P("dp"))  # dataset rows sharded over dp; XLA
+    # re-shards each scanned batch and all-reduces gradients over ICI
+    return jax.jit(
+        fn,
+        in_shardings=(repl, repl, rows, rows, rows, repl),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1),
+    )
+
+
 def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   batch_size: int, num_batches: int, mode: str,
                   shuffle: bool, mesh: Optional[Mesh] = None,
-                  n_real: Optional[int] = None) -> Callable:
+                  n_real: Optional[int] = None, _raw: bool = False) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -218,19 +235,46 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                                                    (xb, yb, mb, step_rngs))
         return params, opt_state, losses
 
-    if mesh is None:
-        return jax.jit(epoch, donate_argnums=(0, 1))
+    if _raw:
+        return epoch
+    return _jit_epoch_like(epoch, mesh)
 
-    epoch = _sharded_trace_guard(epoch, mesh)
-    repl = NamedSharding(mesh, P())
-    rows = NamedSharding(mesh, P("dp"))  # dataset rows sharded over dp; XLA
-    # re-shards each scanned batch and all-reduces gradients over ICI
-    return jax.jit(
-        epoch,
-        in_shardings=(repl, repl, rows, rows, rows, repl),
-        out_shardings=(repl, repl, repl),
-        donate_argnums=(0, 1),
-    )
+
+def make_multi_epoch_fn(loss_fn: Callable,
+                        optimizer: optax.GradientTransformation,
+                        batch_size: int, num_batches: int, mode: str,
+                        shuffle: bool, n_epochs: int,
+                        mesh: Optional[Mesh] = None,
+                        n_real: Optional[int] = None) -> Callable:
+    """``n_epochs`` whole epochs as ONE compiled program (``lax.scan`` over
+    the epoch body): a full ``fit`` becomes a single device dispatch.
+
+    Eliminates per-epoch host round-trips — the launch overhead the
+    per-epoch program still pays once per epoch (and which the reference
+    paid once per MINI-BATCH as an HTTP exchange,
+    ``sparkflow/HogwildSparkModel.py:57-92``). The trainer uses this fast
+    path when nothing host-side (verbose logging, loss callbacks,
+    checkpointing, straggler timing) needs per-epoch control.
+
+    Signature: ``run(params, opt_state, data, labels, mask, erngs) ->
+    (params, opt_state, losses[n_epochs, num_batches])`` where ``erngs`` is
+    the stacked per-epoch rng keys — generated by the caller exactly like
+    the per-epoch loop does, so losses match the loop path bit-for-bit.
+    """
+    body = make_epoch_fn(loss_fn, optimizer, batch_size, num_batches, mode,
+                         shuffle, n_real=n_real, _raw=True)
+
+    def run(params, opt_state, data, labels, mask, erngs):
+        def step(carry, erng):
+            p, s = carry
+            p, s, losses = body(p, s, data, labels, mask, erng)
+            return (p, s), losses
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), erngs)
+        return params, opt_state, losses
+
+    return _jit_epoch_like(run, mesh)
 
 
 def pad_to_batches(x: np.ndarray, batch_size: int,

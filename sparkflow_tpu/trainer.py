@@ -42,7 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from .core import make_epoch_fn, make_loss_fn, make_predict_fn, pad_to_batches
+from .core import (make_epoch_fn, make_loss_fn, make_multi_epoch_fn,
+                   make_predict_fn, pad_to_batches)
 from .graphdef import GraphDef, GraphModel, params_to_list
 from .optimizers import build_optimizer
 
@@ -252,15 +253,6 @@ class Trainer:
                 rng = jnp.asarray(state["rng"])
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
 
-        cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
-                     n if mode == "stochastic" else None)
-        if cache_key not in self._epoch_cache:
-            loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
-            self._epoch_cache[cache_key] = make_epoch_fn(
-                loss_fn, self.optimizer, batch, num_batches, mode,
-                self.shuffle_per_iter, self.mesh, n_real=n)
-        epoch_fn = self._epoch_cache[cache_key]
-
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
         device_args = (jax.tree.map(jnp.asarray, x_pad), jnp.asarray(y_pad),
                        jnp.asarray(mask))
@@ -272,6 +264,45 @@ class Trainer:
         total_epochs = self.partition_shuffles * self.iters
         retries_left = self.resume_retries if ckpt_mgr is not None else 0
         epoch_secs = []  # straggler heartbeat history (opt-in)
+
+        # FAST PATH: nothing host-side needs per-epoch control -> run every
+        # remaining epoch as ONE compiled program (lax.scan over the epoch
+        # body; single device dispatch for the whole fit). Per-epoch rngs are
+        # generated exactly like the loop below, so losses match it.
+        k = total_epochs - start_epoch
+        if (k > 1 and not self.verbose and self.loss_callback is None
+                and ckpt_mgr is None and not self.straggler_factor):
+            fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
+                    n if mode == "stochastic" else None, k)
+            if fkey not in self._epoch_cache:
+                loss_fn = make_loss_fn(self.model, self.input_name,
+                                       self.label_name)
+                self._epoch_cache[fkey] = make_multi_epoch_fn(
+                    loss_fn, self.optimizer, batch, num_batches, mode,
+                    self.shuffle_per_iter, k, self.mesh, n_real=n)
+            erngs = []
+            for _ in range(k):
+                rng, erng = jax.random.split(rng)
+                erngs.append(erng)
+            params, opt_state, losses = self._epoch_cache[fkey](
+                params, opt_state, *device_args, jnp.stack(erngs))
+            params = jax.block_until_ready(params)
+            wall = time.perf_counter() - t0
+            per_epoch = num_batches * batch if mode == "stochastic" else n
+            self.params = params
+            epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
+            return TrainResult(params, epoch_losses,
+                               per_epoch * k / max(wall, 1e-9), wall)
+
+        cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
+                     n if mode == "stochastic" else None)
+        if cache_key not in self._epoch_cache:
+            loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
+            self._epoch_cache[cache_key] = make_epoch_fn(
+                loss_fn, self.optimizer, batch, num_batches, mode,
+                self.shuffle_per_iter, self.mesh, n_real=n)
+        epoch_fn = self._epoch_cache[cache_key]
+
         while True:
             try:
                 it = 0

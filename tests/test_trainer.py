@@ -344,3 +344,16 @@ def test_mesh_sharded_predict_ragged_and_empty(data, dp_mesh):
     assert ragged.shape == (5, 2)
     empty = predict_in_chunks(fn, res.params, np.zeros((0, 10), np.float32))
     assert empty.shape == (0, 2)
+
+
+def test_fused_epochs_match_loop_path(data):
+    """The single-dispatch fused-epochs fast path must produce exactly the
+    loop path's per-epoch losses (identical rng stream)."""
+    X, Y, _ = data
+    kw = dict(iters=6, mini_batch_size=64, learning_rate=0.05, seed=3)
+    fused = Trainer(build_graph(clf_graph), "x:0", "y:0", **kw).fit(X, Y)
+    # a loss_callback forces the per-epoch loop
+    looped = Trainer(build_graph(clf_graph), "x:0", "y:0",
+                     loss_callback=lambda *a: None, **kw).fit(X, Y)
+    assert len(fused.losses) == len(looped.losses) == 6
+    np.testing.assert_allclose(fused.losses, looped.losses, rtol=1e-6)
