@@ -67,7 +67,10 @@ def load_checkpoint_model(checkpoint_path: str,
 _TF_SLOT_SEGMENTS = frozenset(
     ["Adam", "Adam_1", "Momentum", "RMSProp", "RMSProp_1", "Adadelta",
      "Adagrad", "Ftrl", "Ftrl_1", "beta1_power", "beta2_power",
-     "global_step", "save_counter", "_CHECKPOINTABLE_OBJECT_GRAPH"])
+     "global_step", "save_counter", "_CHECKPOINTABLE_OBJECT_GRAPH",
+     # batch-norm moving statistics: never trainable, would otherwise enter
+     # the shape-matching import and collide with gamma/beta shapes
+     "moving_mean", "moving_variance"])
 
 
 def _is_tf_slot_variable(name: str) -> bool:

@@ -41,6 +41,16 @@ def _split_csv(s: Optional[str]) -> list:
     return [t.strip() for t in (s or "").split(",") if t.strip()]
 
 
+def _opt_param(obj, param, default=None):
+    """getOrDefault that tolerates instances persisted by OLDER versions:
+    dill-loaded stages restore the old _defaultParamMap, which lacks Params
+    added since — treat those as their current default instead of KeyError."""
+    try:
+        return obj.getOrDefault(param)
+    except KeyError:
+        return default
+
+
 def build_optimizer(optimizer_name, learning_rate, optimizer_options=None):
     """Name -> optax transformation (reference ``tensorflow_async.py:17-42``)."""
     from .optimizers import build_optimizer as _bo
@@ -126,8 +136,8 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
         tf_output = self.getOrDefault(self.tfOutput)
         tf_dropout = self.getOrDefault(self.tfDropout)
         to_keep_dropout = self.getOrDefault(self.toKeepDropout)
-        extra_cols = _split_csv(self.getOrDefault(self.extraInputCols))
-        extra_inputs = _split_csv(self.getOrDefault(self.extraTfInputs))
+        extra_cols = _split_csv(_opt_param(self, self.extraInputCols))
+        extra_inputs = _split_csv(_opt_param(self, self.extraTfInputs))
         if len(extra_cols) != len(extra_inputs):
             raise ValueError(
                 "extraInputCols (%d names) and extraTfInputs (%d names) must "
@@ -327,7 +337,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         return self.getOrDefault(self.port)
 
     def getFitMode(self):
-        return self.getOrDefault(self.fitMode)
+        return _opt_param(self, self.fitMode, "collect")
 
     def _validate_params(self):
         """Error loudly on inconsistent Param combinations — the reference
@@ -350,8 +360,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if fit_mode not in ("collect", "stream"):
             raise ValueError("fitMode must be 'collect' or 'stream', got %r"
                              % self.getFitMode())
-        extra_cols = _split_csv(self.getOrDefault(self.extraInputCols))
-        extra_inputs = _split_csv(self.getOrDefault(self.extraTfInputs))
+        extra_cols = _split_csv(_opt_param(self, self.extraInputCols))
+        extra_inputs = _split_csv(_opt_param(self, self.extraTfInputs))
         if len(extra_cols) != len(extra_inputs):
             raise ValueError(
                 "extraInputCols (%d names) and extraTfInputs (%d names) must "
@@ -453,5 +463,5 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             tfDropout=self.getTfDropout(),
             toKeepDropout=self.getToKeepDropout(),
             predictionCol=self.getOrDefault(self.predictionCol),
-            extraInputCols=self.getOrDefault(self.extraInputCols),
-            extraTfInputs=self.getOrDefault(self.extraTfInputs))
+            extraInputCols=_opt_param(self, self.extraInputCols),
+            extraTfInputs=_opt_param(self, self.extraTfInputs))

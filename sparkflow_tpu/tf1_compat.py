@@ -241,6 +241,14 @@ class TF1GraphModel:
         target = self._per_example_loss_node(self._loss_names[0].split(":")[0])
         ev = _Evaluator(self, params, feeds, train, rng)
         val = jnp.asarray(ev.value(target))
+        # EVERY additional losses-collection entry contributes (the usual
+        # pattern: add_to_collection(LOSSES, weight_decay)); scalars spread
+        # per-example, batch-shaped entries reduce per-example
+        for name in self._loss_names[1:]:
+            extra = jnp.asarray(ev.value(name.split(":")[0]))
+            if extra.ndim > 1:
+                extra = jnp.mean(extra.reshape(extra.shape[0], -1), axis=-1)
+            val = val + extra
         if val.ndim == 0:
             # irreducibly scalar loss: broadcast (padding correctness is then
             # the caller's concern; reference losses all pass the walk above)

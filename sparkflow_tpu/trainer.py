@@ -184,11 +184,14 @@ class Trainer:
 
     def fit(self, features, labels: Optional[np.ndarray] = None,
             init_params=None) -> TrainResult:
-        multi = isinstance(features, (list, tuple))
+        # multi-input features travel as a TUPLE of arrays; a plain list is
+        # row data (np.asarray coercible), exactly as in single-input fits
+        multi = isinstance(features, tuple)
         n_inputs = (len(self.input_name)
                     if isinstance(self.input_name, (list, tuple)) else 1)
         if multi != (n_inputs > 1) or (multi and len(features) != n_inputs):
-            got = f"a tuple of {len(features)} arrays" if multi else "one array"
+            got = (f"a tuple of {len(features)} arrays" if multi
+                   else "a single features array")
             raise ValueError(
                 f"model takes {n_inputs} input tensor(s) "
                 f"({self.input_name}) but fit() got {got}")

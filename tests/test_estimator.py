@@ -393,3 +393,24 @@ def test_model_transform_validates_extra_pairing(spark, gaussian_df):
     fitted._set(extraInputCols="mask")  # tfInputs left unset
     with pytest.raises(ValueError, match="pair up"):
         fitted.transform(gaussian_df)
+
+
+def test_old_persisted_model_without_new_params_still_transforms(spark, gaussian_df):
+    """Instances dill-persisted by older versions lack newly added Params in
+    their restored default map; transform/fit must treat them as defaults,
+    not KeyError (forward compatibility of saved pipelines)."""
+    mg = build_graph(create_model)
+    model = base_estimator(mg, iters=3).fit(gaussian_df)
+    # simulate a round-1 pickle: strip the round-2 Params from the maps
+    for pname in ("extraInputCols", "extraTfInputs"):
+        p = getattr(model, pname)
+        model._defaultParamMap.pop(p, None)
+        model._paramMap.pop(p, None)
+    assert model.transform(gaussian_df).count() == 400
+
+    est = base_estimator(mg, iters=2)
+    for pname in ("extraInputCols", "extraTfInputs", "fitMode"):
+        p = getattr(est, pname)
+        est._defaultParamMap.pop(p, None)
+        est._paramMap.pop(p, None)
+    est.fit(gaussian_df)  # no KeyError

@@ -347,6 +347,10 @@ def test_l2loss_and_pad_ops():
     lv = m.loss_vector(params, {"x": X, "y": np.zeros((4, 1), np.float32)},
                        train=False)
     assert lv.shape == (4,) and np.isfinite(np.asarray(lv)).all()
+    # the second LOSSES-collection entry (the l2 term) must contribute:
+    # kernel is all-ones (5,1) -> l2 = 2.5, weighted 1e-3
+    mse = ((X.sum(1) - 0.0) ** 2)  # out - y with y = 0
+    np.testing.assert_allclose(np.asarray(lv), mse + 1e-3 * 2.5, rtol=1e-5)
 
 
 def test_metagraph_trains_on_dp_mesh(mlp_metagraph, dp_mesh):

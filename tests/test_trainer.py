@@ -357,3 +357,16 @@ def test_fused_epochs_match_loop_path(data):
                      loss_callback=lambda *a: None, **kw).fit(X, Y)
     assert len(fused.losses) == len(looped.losses) == 6
     np.testing.assert_allclose(fused.losses, looped.losses, rtol=1e-6)
+
+
+def test_fit_accepts_plain_python_lists():
+    """Round-1 behavior: list-of-rows coerces to an array (lists are data,
+    only TUPLES mean multi-input)."""
+    def m():
+        x = nn.placeholder([None, 2], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        nn.mean_squared_error(y, nn.dense(x, 1, name="out"))
+
+    tr = Trainer(build_graph(m), "x:0", "y:0", iters=2, mini_batch_size=4)
+    res = tr.fit([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], [1.0, 2.0, 3.0])
+    assert len(res.losses) == 2
