@@ -24,6 +24,15 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 TPU_CACHE = os.path.join(_HERE, "BENCH_TPU_CACHE.json")
 
 
+def _load_baseline():
+    """Current baseline ex/s from BASELINE_MEASURED.json, or None."""
+    path = os.path.join(_HERE, "BASELINE_MEASURED.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)["baseline_examples_per_sec"]
+
+
 def _load_cached_tpu_result():
     if not os.path.exists(TPU_CACHE):
         return None
@@ -64,12 +73,9 @@ def main():
             # misrepresent TPU throughput far worse)
             # recompute the ratio against the CURRENT baseline file — the
             # baseline may have been re-measured since the capture
-            vs = cached["vs_baseline"]
-            base_path = os.path.join(_HERE, "BASELINE_MEASURED.json")
-            if os.path.exists(base_path):
-                with open(base_path) as f:
-                    vs = round(cached["value"]
-                               / json.load(f)["baseline_examples_per_sec"], 2)
+            base = _load_baseline()
+            vs = (round(cached["value"] / base, 2) if base
+                  else cached["vs_baseline"])
             out = {
                 "metric": cached["metric"],
                 "value": cached["value"],
@@ -123,13 +129,8 @@ def main():
     res = trainer.fit(x, y, init_params=trainer.params)
     eps = res.examples_per_sec
 
-    vs_baseline = None
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BASELINE_MEASURED.json")
-    if os.path.exists(base_path):
-        with open(base_path) as f:
-            base = json.load(f)["baseline_examples_per_sec"]
-        vs_baseline = round(eps / base, 2)
+    base = _load_baseline()
+    vs_baseline = round(eps / base, 2) if base else None
 
     out = {
         "metric": "mnist_cnn_examples_per_sec",

@@ -439,8 +439,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if weights_path:
             if not weights_path.endswith(".npz"):
                 weights_path += ".npz"
-            np.savez(weights_path,
-                     **{f"w_{i}": w for i, w in enumerate(trainer.weights_list())})
+            from .model_loader import save_weights_npz
+            save_weights_npz(weights_path, trainer.weights_list())
             # NOTE: the model stores this PATH, not the weights — unlike the
             # reference's self-contained inline JSON, the file must be visible
             # to every executor/machine that transforms or loads the pipeline
