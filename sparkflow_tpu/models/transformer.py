@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops import flash_attention, ring_attention
+from ..ops import flash_attention, ring_flash_attention
 from .base import RegistryModel
 from .registry import register_model
 
@@ -121,8 +121,10 @@ class _TransformerBase(RegistryModel):
     def _attention(self, q, k, v, mask, causal: bool):
         """[B,S,H*D] qkv already split to [B,heads,S,D]."""
         if self.sp_axis is not None:
-            return ring_attention(q, k, v, self.sp_axis, causal=causal,
-                                  kv_mask=mask)
+            # pallas kernel per visiting block when shapes tile; jnp ring
+            # otherwise — numerics identical either way
+            return ring_flash_attention(q, k, v, self.sp_axis, causal=causal,
+                                        kv_mask=mask)
         # the kernel takes the key-padding mask directly; odd shapes fall back
         # to the blockwise/reference paths inside flash_attention
         return flash_attention(q, k, v, causal=causal, kv_mask=mask)

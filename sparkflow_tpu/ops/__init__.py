@@ -9,8 +9,13 @@ scheduling are hand-written pallas kernels with jnp fallbacks for CPU tests:
 - :func:`ring_attention`  — sequence-parallel attention over an ``sp`` mesh
   axis: K/V shards rotate around the ICI ring while softmax statistics merge
   blockwise, giving O(S/n) memory per device for arbitrarily long sequences
+- :func:`ring_flash_attention` — the same ring, but each visiting block runs
+  the pallas flash kernel (device-local operands inside shard_map) and blocks
+  merge exactly via the kernel's saved logsumexp
 """
 
-from .attention import flash_attention, ring_attention, attention_reference
+from .attention import (attention_reference, flash_attention, ring_attention,
+                        ring_flash_attention)
 
-__all__ = ["flash_attention", "ring_attention", "attention_reference"]
+__all__ = ["flash_attention", "ring_attention", "ring_flash_attention",
+           "attention_reference"]

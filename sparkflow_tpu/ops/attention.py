@@ -538,14 +538,20 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
     have_mask = kv_mask is not None
 
+    @jax.checkpoint
+    def fold(acc, m, l, kc, vc, mc, k_offset):
+        # remat per visit: backward recomputes the [S_local, S_local] block
+        # instead of saving one per visit (which would rebuild the full
+        # S_local x S_global score matrix ring attention exists to avoid)
+        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, q_offset, k_offset,
+                                  mc if have_mask else None)
+        return _merge_stats(acc, m, l, a2, m2, l2)
+
     def body(step, carry):
         acc, m, l, kc, vc, mc = carry
         # the k/v block currently resident came from device (idx - step) % n
         src = (idx - step) % n
-        k_offset = src * s_local
-        a2, m2, l2 = _block_stats(q, kc, vc, scale, causal, q_offset, k_offset,
-                                  mc if have_mask else None)
-        acc, m_new, l = _merge_stats(acc, m, l, a2, m2, l2)
+        acc, m_new, l = fold(acc, m, l, kc, vc, mc, src * s_local)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         if have_mask:
@@ -560,3 +566,121 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             kv_mask if have_mask else jnp.zeros((b, sl), jnp.float32))
     acc, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, init)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         sm_scale: Optional[float] = None, kv_mask=None,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: Optional[bool] = None):
+    """Ring attention whose per-visit block compute is the PALLAS flash
+    kernel (inside shard_map operands are device-local, so the kernel needs
+    no partitioning rule — same principle as
+    :func:`~sparkflow_tpu.parallel.dp.make_dp_shardmap_train_step`).
+
+    The kernel's saved logsumexp makes cross-visit merging exact: visiting
+    blocks combine as ``o = sum_i o_i * exp(lse_i - lse_total)`` with
+    ``lse_total = logaddexp_i lse_i``. Causality with equal sequence shards
+    reduces to three whole-block cases per visit — source shard strictly
+    behind (full attention), same shard (locally-causal kernel, since the
+    local diagonal IS the global diagonal), or strictly ahead (zero
+    contribution) — so the kernel never needs global offsets.
+
+    Falls back to :func:`ring_attention` when shapes don't satisfy the
+    kernel's tiling constraints. The backward is a jnp-ring RECOMPUTE (custom
+    VJP over the whole ring, per-visit remat) — the pallas backward kernels
+    are not involved on this path; the kernel win applies to the forward.
+    """
+    b, h, sl, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    bq = min(block_q, sl)
+    bk = min(block_k, sl)
+    tiles_ok = (pltpu is not None and sl % bq == 0 and sl % bk == 0
+                and bq % 8 == 0 and bk % 128 == 0 and d % 8 == 0)
+    if not tiles_ok:
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              sm_scale=sm_scale, kv_mask=kv_mask)
+
+    return _ring_flash(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
+                       interpret)
+
+
+def _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
+                        interpret):
+    b, h, sl, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    have_mask = kv_mask is not None
+
+    def visit(kc, vc, mc, local_causal):
+        out, lse = _flash_pallas_forward(
+            q, kc, vc, mc if have_mask else None, local_causal, scale,
+            bq, bk, interpret, with_lse=True)
+        return out.astype(jnp.float32), lse
+
+    def body(step, carry):
+        o, lse, kc, vc, mc = carry
+        src = (idx - step) % n
+        if causal:
+            # three whole-block cases per visit (equal shards make the local
+            # diagonal the global one): strictly-behind source -> full
+            # attention; same shard -> locally-causal kernel; strictly-ahead
+            # -> SKIPPED entirely (no kernel launch, zero contribution)
+            branch = jnp.where(src == idx, 1, jnp.where(src > idx, 2, 0))
+            o2, lse2 = jax.lax.switch(branch, [
+                lambda: visit(kc, vc, mc, False),
+                lambda: visit(kc, vc, mc, True),
+                lambda: (jnp.zeros((b, h, sl, d), jnp.float32),
+                         jnp.full((b, h, sl), NEG_INF, jnp.float32)),
+            ])
+        else:
+            o2, lse2 = visit(kc, vc, mc, False)
+        # exact merge via logsumexp weights
+        lse_new = jnp.logaddexp(lse, lse2)                    # [B,H,S]
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o2 * jnp.exp(lse2 - lse_new)[..., None])
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        if have_mask:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        return o, lse_new, kc, vc, mc
+
+    init = (jnp.zeros((b, h, sl, d), jnp.float32),
+            jnp.full((b, h, sl), NEG_INF, jnp.float32),
+            k, v,
+            kv_mask if have_mask else jnp.zeros((b, sl), jnp.float32))
+    o, lse, _, _, _ = jax.lax.fori_loop(0, n, body, init)
+    return o.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, kv_mask, axis_name, causal, scale, bq, bk, interpret):
+    return _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale,
+                               bq, bk, interpret)
+
+
+def _ring_flash_fwd(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
+                    interpret):
+    out = _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale,
+                              bq, bk, interpret)
+    return out, (q, k, v, kv_mask)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, bq, bk, interpret, res, g):
+    # recompute-style backward through the differentiable jnp ring (the
+    # ppermute transposes to the reverse ring automatically) — the same
+    # recompute pattern the flash kernel itself used before its pallas
+    # backward landed; keeps memory bounded and gradients exact
+    q, k, v, kv_mask = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: ring_attention(q, k, v, axis_name, causal=causal,
+                                       sm_scale=scale, kv_mask=kv_mask),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -167,3 +167,73 @@ def test_flash_bwd_bf16():
         assert a.dtype == jnp.bfloat16
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=0.15)
+
+
+def test_ring_flash_matches_ring_and_reference(dp_mesh):
+    """ring_flash_attention (pallas per-visit blocks + lse merge) must equal
+    plain ring attention and the dense reference, causal and not, fwd + bwd."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from sparkflow_tpu.ops import ring_flash_attention
+
+    mesh = dp_mesh  # 8 devices, axis 'dp'
+    rs = np.random.RandomState(0)
+    B, H, S, D = 1, 2, 1024, 8  # S/8 = 128 per shard: kernel tiling holds
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+               for _ in range(3))
+
+    for causal in (False, True):
+        def ring_fn(q, k, v):
+            return ring_flash_attention(q, k, v, "dp", causal=causal)
+
+        out = shard_map(ring_fn, mesh=mesh,
+                        in_specs=(P(None, None, "dp", None),) * 3,
+                        out_specs=P(None, None, "dp", None),
+                        check_vma=False)(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, err_msg=f"causal={causal}")
+
+        # gradients flow through the custom VJP (jnp-ring recompute)
+        def loss(q, k, v):
+            return shard_map(ring_fn, mesh=mesh,
+                             in_specs=(P(None, None, "dp", None),) * 3,
+                             out_specs=P(None, None, "dp", None),
+                             check_vma=False)(q, k, v).sum()
+
+        gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: attention_reference(
+            a, b, c, causal=causal).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, err_msg=f"causal={causal}")
+
+
+def test_ring_flash_kv_mask_path(dp_mesh):
+    """The mask carry (mc rotating the ring into the kernel's mask BlockSpec)
+    — the genuinely new data flow — causal and not."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from sparkflow_tpu.ops import ring_flash_attention
+
+    rs = np.random.RandomState(4)
+    B, H, S, D = 1, 2, 1024, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray((rs.rand(B, S) > 0.25).astype(np.float32))
+
+    for causal in (False, True):
+        def ring_fn(q, k, v, m):
+            return ring_flash_attention(q, k, v, "dp", causal=causal,
+                                        kv_mask=m)
+
+        out = shard_map(ring_fn, mesh=dp_mesh,
+                        in_specs=(P(None, None, "dp", None),) * 3
+                        + (P(None, "dp"),),
+                        out_specs=P(None, None, "dp", None),
+                        check_vma=False)(q, k, v, mask)
+        ref = attention_reference(q, k, v, causal=causal, kv_mask=mask)
+        # masked rows that are fully excluded under causal+mask can differ
+        # in garbage content; compare only rows with any visible key
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, err_msg=f"causal={causal}")
