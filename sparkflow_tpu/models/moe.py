@@ -34,12 +34,20 @@ class _MoEMixin:
     """Replaces the dense FFN with a capacity-routed expert bank on MoE layers."""
 
     def _init_moe(self, num_experts: int, moe_every: int, aux_weight: float,
-                  capacity_factor: float = 1.25, router_top_k: int = 1):
+                  capacity_factor: float = 1.25, router_top_k: int = 1,
+                  ep_axis: Optional[str] = None):
         self.num_experts = num_experts
         self.moe_every = max(1, moe_every)
         self.aux_weight = aux_weight
         self.capacity_factor = capacity_factor
         self.router_top_k = max(1, min(router_top_k, num_experts))
+        # ep_axis: run the FFN via all_to_all dispatch inside shard_map over
+        # this mesh axis (ops/moe_dispatch; top-1 only) — the communicating
+        # form of expert parallelism; None keeps the GSPMD slot dispatch
+        self.ep_axis = ep_axis
+        if ep_axis is not None and self.router_top_k != 1:
+            raise ValueError("all_to_all dispatch (ep_axis) supports "
+                             "router_top_k=1 only")
 
     def _is_moe_layer(self, i: int) -> bool:
         return (i % self.moe_every) == (self.moe_every - 1)
@@ -101,6 +109,12 @@ class _MoEMixin:
         would otherwise flood one expert and evict real tokens) and don't
         enter the load-balancing statistics.
         """
+        if self.ep_axis is not None:
+            from ..ops.moe_dispatch import all_to_all_moe_ffn
+            return all_to_all_moe_ffn(
+                x, bp["router"], bp["experts_fc1"], bp["experts_b1"],
+                bp["experts_fc2"], bp["experts_b2"], self.ep_axis,
+                self.num_experts, self.capacity_factor, token_mask)
         b, s, h = x.shape
         e = self.num_experts
         k = self.router_top_k
@@ -196,9 +210,10 @@ class MoETransformerLM(_MoEMixin, _TransformerBase):
 
     def __init__(self, vocab_size: int, num_experts: int = 8, moe_every: int = 2,
                  router_aux_weight: float = 0.01,
-                 capacity_factor: float = 1.25, router_top_k: int = 1, **kw):
+                 capacity_factor: float = 1.25, router_top_k: int = 1,
+                 ep_axis: Optional[str] = None, **kw):
         self._init_moe(num_experts, moe_every, router_aux_weight,
-                       capacity_factor, router_top_k)
+                       capacity_factor, router_top_k, ep_axis)
         super().__init__(vocab_size, **kw)
         self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
         self.graphdef = _Names(self.TENSORS)

@@ -1,0 +1,114 @@
+"""all_to_all expert dispatch: the communicating form of expert parallelism.
+
+``models/moe.py``'s capacity dispatch runs under GSPMD (the expert einsum's
+sharding makes XLA insert the collective). This module is the explicit
+shard_map form — the GShard pipeline (Lepikhin et al.; PAPERS.md pattern):
+
+    route locally -> all_to_all token buffers over the ``ep`` axis ->
+    each device runs ONLY its local experts -> all_to_all back -> combine
+
+Every device holds a batch shard AND ``E/n`` experts of the bank; tokens
+move to their expert's device over ICI and return. With ``E == n`` (one
+expert per device — the common pod configuration) there is zero redundant
+FLOP anywhere. Used inside ``shard_map`` (see
+``parallel/ep.make_moe_shardmap_train_step``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
+                       experts_b2, axis_name: str, num_experts: int,
+                       capacity_factor: float = 1.25, token_mask=None):
+    """Top-1 routed expert FFN with all_to_all dispatch.
+
+    Args (device-local views inside shard_map over ``axis_name``):
+      x            [B_local, S, H] token activations (batch sharded)
+      router_w     [H, E] replicated router
+      experts_fc1  [E_local, H, M] — THIS device's slice of the expert bank
+      experts_b1   [E_local, M]
+      experts_fc2  [E_local, M, H]
+      experts_b2   [E_local, H]
+      token_mask   optional [B_local, S]; masked tokens claim no capacity
+
+    Returns ``(combined [B_local, S, H], aux_loss scalar-per-device)``.
+    The aux loss is the Switch load-balance term over LOCAL tokens; callers
+    typically ``pmean`` it across the axis.
+    """
+    try:
+        n = jax.lax.axis_size(axis_name)
+    except NameError as e:
+        raise NameError(
+            f"mesh axis {axis_name!r} is not bound: an ep_axis MoE model "
+            f"must run inside shard_map over that axis — use "
+            f"parallel.ep.make_moe_shardmap_train_step (or build the model "
+            f"without ep_axis for the GSPMD dispatch)") from e
+    b, s, h = x.shape
+    nl = b * s                      # local tokens
+    e = num_experts
+    e_local = experts_fc1.shape[0]
+    assert e_local * n == e, (e_local, n, e)
+    # per (device -> peer) buffer capacity: tokens THIS device may send to
+    # one peer. cf * nl / n is the balanced share; generous by design.
+    cap = max(1, int(-(-capacity_factor * nl // n)))
+
+    xf = x.reshape(nl, h)
+    logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), router_w)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [Nl, E]
+    expert_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    gate = jnp.max(probs, axis=-1)
+    live = (token_mask.reshape(nl).astype(jnp.float32)
+            if token_mask is not None else jnp.ones((nl,), jnp.float32))
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32) * live[:, None]
+    aux = e * jnp.sum((jnp.sum(onehot, axis=0)
+                       / jnp.maximum(jnp.sum(live), 1.0))
+                      * (jnp.sum(probs * live[:, None], axis=0)
+                         / jnp.maximum(jnp.sum(live), 1.0)))
+
+    # destination peer for each token + position in that peer's send buffer
+    dest = expert_idx // e_local                            # [Nl]
+    dest_oh = jax.nn.one_hot(dest, n, dtype=jnp.float32) * live[:, None]
+    pos = jnp.sum((jnp.cumsum(dest_oh, axis=0) - 1.0) * dest_oh,
+                  axis=-1).astype(jnp.int32)
+    kept = (pos < cap) & (live > 0)
+    slot = jnp.where(kept, dest * cap + pos, n * cap)       # overflow bin
+
+    # scatter tokens into [n, cap] send buffers (+1 overflow row)
+    token_for_slot = jnp.full((n * cap + 1,), nl, dtype=jnp.int32)
+    token_for_slot = token_for_slot.at[slot].set(
+        jnp.arange(nl, dtype=jnp.int32))[:n * cap]
+    xf_pad = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)], axis=0)
+    send_x = xf_pad[token_for_slot].reshape(n, cap, h)
+    # sidecar: which LOCAL expert on the destination + validity
+    le_pad = jnp.concatenate(
+        [(expert_idx % e_local), jnp.zeros((1,), jnp.int32)])
+    send_le = le_pad[token_for_slot].reshape(n, cap)
+    send_valid = (token_for_slot < nl).astype(jnp.float32).reshape(n, cap)
+
+    # the exchange: slab j of send goes to peer j; recv slab j came from j
+    recv_x = jax.lax.all_to_all(send_x, axis_name, 0, 0, tiled=False)
+    recv_le = jax.lax.all_to_all(send_le, axis_name, 0, 0, tiled=False)
+    recv_valid = jax.lax.all_to_all(send_valid, axis_name, 0, 0, tiled=False)
+
+    # local expert compute over the n*cap received tokens; one-hot combine
+    # over E_local only (E_local == 1 on E == n meshes: no redundancy)
+    rt = recv_x.reshape(n * cap, h)
+    le_oh = (jax.nn.one_hot(recv_le.reshape(-1), e_local, dtype=jnp.float32)
+             * recv_valid.reshape(-1)[:, None])             # [n*cap, E_local]
+    hid = jnp.einsum("th,ehm->etm", rt, experts_fc1.astype(rt.dtype))
+    hid = jax.nn.gelu(hid + experts_b1.astype(hid.dtype)[:, None, :])
+    out = jnp.einsum("etm,emh->eth", hid, experts_fc2.astype(hid.dtype))
+    out = out + experts_b2.astype(out.dtype)[:, None, :]
+    out = jnp.einsum("eth,te->th", out, le_oh.astype(out.dtype))
+
+    # send results home and combine into original token positions
+    back = jax.lax.all_to_all(out.reshape(n, cap, h), axis_name, 0, 0,
+                              tiled=False)
+    back_pad = jnp.concatenate([back.reshape(n * cap, h),
+                                jnp.zeros((1, h), back.dtype)], axis=0)
+    y = back_pad[slot] * gate[:, None].astype(back.dtype)
+    return y.reshape(b, s, h).astype(x.dtype), aux

@@ -299,3 +299,62 @@ def test_moe_top2_trains_and_shards():
         params, state, l = step(params, state)
         first = first if first is not None else float(l)
     assert float(l) < first
+
+
+def test_moe_all_to_all_shardmap_matches_replicated():
+    """The shard_map all_to_all EP path (GShard pipeline: route -> exchange
+    -> local experts -> exchange back) must match the single-device
+    capacity-dispatch model with the same weights, and train."""
+    from sparkflow_tpu.parallel.ep import (make_moe_shardmap_train_step,
+                                           place_moe_params)
+
+    mesh = make_mesh({"ep": 8})
+    kw = dict(vocab_size=40, num_experts=8, moe_every=1, hidden=32,
+              num_layers=2, num_heads=4, mlp_dim=64, max_len=16,
+              dropout=0.0, capacity_factor=8.0)
+    m_a2a = model_from_json(build_registry_spec("transformer_moe_lm",
+                                                ep_axis="ep", **kw))
+    m_ref = model_from_json(build_registry_spec("transformer_moe_lm", **kw))
+    params = m_ref.init(jax.random.PRNGKey(0))
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 40, (16, 16)), jnp.int32)
+    mask = jnp.ones((16, 16), jnp.float32)
+
+    opt = build_optimizer("gradient_descent", 0.05, None)
+    placed = place_moe_params(m_a2a, jax.tree.map(jnp.copy, params), mesh)
+    step = make_moe_shardmap_train_step(m_a2a, opt, mesh)
+    state = opt.init(placed)
+    placed, state, loss = step(placed, state, ids, mask, jax.random.PRNGKey(1))
+
+    ref_loss = m_ref.loss_vector(
+        params, {"input_ids": ids, "attention_mask": mask},
+        train=False).mean()
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+
+    first = float(loss)
+    for i in range(5):
+        placed, state, loss = step(placed, state, ids, mask,
+                                   jax.random.PRNGKey(i + 2))
+    assert float(loss) < first
+    # expert shards stayed sharded through the update
+    assert "ep" in str(placed["block_0"]["experts_fc1"].sharding.spec)
+
+
+def test_moe_a2a_rejects_topk():
+    with pytest.raises(ValueError, match="router_top_k=1"):
+        model_from_json(build_registry_spec(
+            "transformer_moe_lm", vocab_size=10, num_experts=4,
+            router_top_k=2, ep_axis="ep", hidden=8, num_layers=1,
+            num_heads=2, mlp_dim=16, max_len=4))
+
+
+def test_moe_a2a_outside_shardmap_fails_actionably():
+    m = model_from_json(build_registry_spec(
+        "transformer_moe_lm", vocab_size=20, num_experts=4, moe_every=1,
+        ep_axis="ep", hidden=16, num_layers=1, num_heads=2, mlp_dim=32,
+        max_len=8, dropout=0.0))
+    p = m.init(jax.random.PRNGKey(0))
+    ids = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(NameError, match="make_moe_shardmap_train_step"):
+        m.loss_vector(p, {"input_ids": ids}, train=False)
