@@ -83,19 +83,23 @@ def pp_pspecs(pp_params):
 
 
 def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
-                       pp_axis: str = "pp", schedule: str = "gpipe"):
+                       pp_axis: str = "pp", schedule: str = "gpipe",
+                       dp_axis: str = "dp"):
     """Pipeline-parallel train step for the transformer classifier.
 
     Signature: ``step(pp_params, opt_state, ids, y, rng) ->
-    (pp_params, opt_state, loss)`` — ids [B, S] replicated across pp (batch is
-    the microbatch loop's dimension), params in :func:`split_stage_params`
-    layout sharded over 'pp'. ``schedule`` is ``'gpipe'`` (overlapped,
-    ``M + P - 1`` serial stage-times) or ``'sequential'`` (``M * P``, the
-    numerics baseline). The returned callable exposes ``schedule_ticks``: the
-    number of serial stage-computations in its forward sweep.
+    (pp_params, opt_state, loss)`` — params in :func:`split_stage_params`
+    layout sharded over 'pp'. When the mesh ALSO has a ``dp_axis``, the batch
+    shards over it and each data-parallel replica runs the pipeline on its
+    shard (stage grads pmean over dp; composition of pp x dp). ``schedule``
+    is ``'gpipe'`` (overlapped, ``M + P - 1`` serial stage-times) or
+    ``'sequential'`` (``M * P``, the numerics baseline). The returned
+    callable exposes ``schedule_ticks``: the number of serial
+    stage-computations in its forward sweep.
     """
     if schedule not in ("gpipe", "sequential"):
         raise ValueError(f"unknown pp schedule {schedule!r}")
+    has_dp = dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
     n_stages = mesh.shape[pp_axis]
     per = model.num_layers // n_stages
     M = n_microbatches
@@ -164,8 +168,12 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
                        model.compute_dtype or jnp.float32)
         (_, loss_acc), _ = jax.lax.scan(tick, (x0, jnp.zeros(())),
                                         jnp.arange(T))
-        # every stage's partial losses (only the last stage has any) summed
-        return jax.lax.psum(loss_acc, pp_axis) / M
+        # LOCAL contribution (nonzero on the last stage only). Deliberately
+        # NOT psum'd here: differentiating through a psum inside shard_map
+        # transposes it as psum — every device would receive the SUM of all
+        # devices' cotangent seeds and grads would inflate by P. The caller
+        # psums the forward value for reporting only.
+        return loss_acc / M
 
     # ---- sequential: one stage live per tick (round-1 baseline) -----------
 
@@ -192,14 +200,16 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         pooled = jnp.mean(x, axis=1).astype(jnp.float32)
         logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
         per_ex = -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
-        # only stage 0 holds the real result; zero others and sum over pp
-        loss = jnp.where(s == 0, jnp.mean(per_ex), 0.0)
-        return jax.lax.psum(loss, pp_axis)
+        # only stage 0 holds the real result: the LOCAL masked contribution
+        # (no psum here — see gpipe_loss on why psum-in-the-loss inflates
+        # gradients by P under shard_map autodiff)
+        return jnp.where(s == 0, jnp.mean(per_ex), 0.0)
 
     param_specs = {"stages": P(pp_axis), "shared": P()}  # pytree prefixes
+    data_spec = P(dp_axis) if has_dp else P()
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(param_specs, P(), P(), P()),
+             in_specs=(param_specs, data_spec, data_spec, P()),
              out_specs=(param_specs, P()),
              check_vma=False)
     def grad_fn(pp_params, ids, y, rng):
@@ -207,9 +217,12 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             raise ValueError(
                 f"batch {ids.shape[0]} must be a positive multiple of "
                 f"n_microbatches={M}")
+        if has_dp:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(dp_axis))
         if schedule == "gpipe":
             loss, grads = jax.value_and_grad(gpipe_loss, argnums=0)(
                 pp_params, ids, y, rng)
+            loss = jax.lax.psum(loss, pp_axis)  # reporting only
         else:
             # per-microbatch value_and_grad accumulation: only one
             # microbatch's activations are ever live during backward
@@ -225,11 +238,15 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             zero = jax.tree.map(jnp.zeros_like, pp_params)
             grads, loss = jax.lax.fori_loop(0, M, micro, (zero, jnp.zeros(())))
             grads = jax.tree.map(lambda x: x / M, grads)
-            loss = loss / M
+            loss = jax.lax.psum(loss, pp_axis) / M  # reporting only
         # shared params got gradient contributions on every stage: reduce;
-        # stage params are exclusively local (their grads are already correct)
+        # stage params are exclusively pp-local (grads already correct per
+        # stage) but with data parallelism every dp replica contributed
         grads["shared"] = jax.tree.map(
             lambda gg: jax.lax.psum(gg, pp_axis), grads["shared"])
+        if has_dp:
+            grads = jax.tree.map(lambda gg: jax.lax.pmean(gg, dp_axis), grads)
+            loss = jax.lax.pmean(loss, dp_axis)
         return grads, loss
 
     def step(pp_params, opt_state, ids, y, rng):

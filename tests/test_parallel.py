@@ -358,3 +358,34 @@ def test_moe_a2a_outside_shardmap_fails_actionably():
     ids = jnp.zeros((2, 8), jnp.int32)
     with pytest.raises(NameError, match="make_moe_shardmap_train_step"):
         m.loss_vector(p, {"input_ids": ids}, train=False)
+
+
+def test_pp_composes_with_dp(pp_setup):
+    """pp(4) x dp(2): batch sharded over dp, stages over pp — one step must
+    match the single-device loss/update (dropout 0, equal shards)."""
+    m, params = pp_setup
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    opt = build_optimizer("gradient_descent", 0.1, None)
+    rs = np.random.RandomState(2)
+    ids = jnp.asarray(rs.randint(0, 40, (8, 16)), jnp.int32)
+    y = jnp.asarray(np.eye(3)[rs.randint(0, 3, 8)], jnp.float32)
+
+    pp = shard_params(split_stage_params(m, params, 4), mesh,
+                      pp_pspecs(split_stage_params(m, params, 4)))
+    step = make_pp_train_step(m, opt, mesh, n_microbatches=2)
+    p2, _, loss = step(pp, opt.init(pp), ids, y, jax.random.PRNGKey(5))
+    ref = m.loss_vector(params, {"input_ids": ids, "y": y},
+                        train=False).mean()
+    np.testing.assert_allclose(float(loss), float(ref), atol=1e-4)
+
+    # the update equals plain single-device SGD on the same global batch
+    import optax
+    def ref_loss(p):
+        return m.loss_vector(p, {"input_ids": ids, "y": y},
+                             train=False).mean()
+    g = jax.grad(ref_loss)(params)
+    sgd_params = optax.apply_updates(params, jax.tree.map(lambda x: -0.1 * x, g))
+    back = merge_stage_params(m, p2)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(sgd_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
