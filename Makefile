@@ -36,3 +36,9 @@ native:
 clean:
 	rm -rf sparkflow_tpu/native/_build .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+# round-2 example additions (text pipeline; TF1 migration needs tensorflow)
+examples-extra:
+	cd examples && SPARKFLOW_TPU_SMOKE=1 python text_classifier.py && \
+	SPARKFLOW_TPU_SMOKE=1 python bert_classifier.py && \
+	SPARKFLOW_TPU_SMOKE=1 python tf1_migration.py
