@@ -84,22 +84,31 @@ def pp_pspecs(pp_params):
 
 def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
                        pp_axis: str = "pp", schedule: str = "gpipe",
-                       dp_axis: str = "dp"):
-    """Pipeline-parallel train step for the transformer classifier.
+                       dp_axis: str = "dp", task: str = "classifier"):
+    """Pipeline-parallel train step for the transformer families.
 
     Signature: ``step(pp_params, opt_state, ids, y, rng) ->
     (pp_params, opt_state, loss)`` — params in :func:`split_stage_params`
-    layout sharded over 'pp'. When the mesh ALSO has a ``dp_axis``, the batch
-    shards over it and each data-parallel replica runs the pipeline on its
-    shard (stage grads pmean over dp; composition of pp x dp). ``schedule``
-    is ``'gpipe'`` (overlapped, ``M + P - 1`` serial stage-times) or
-    ``'sequential'`` (``M * P``, the numerics baseline). The returned
-    callable exposes ``schedule_ticks``: the number of serial
-    stage-computations in its forward sweep.
+    layout sharded over 'pp'. ``task``:
+
+    - ``'classifier'`` — ``y`` is one-hot labels [B, C]; mean-pool + CE head.
+    - ``'lm'``        — causal next-token NLL; ``y`` is the attention mask
+      [B, S] (token weights for the loss; blocks run causal).
+
+    When the mesh ALSO has a ``dp_axis``, the batch shards over it and each
+    data-parallel replica runs the pipeline on its shard (stage grads pmean
+    over dp; composition of pp x dp). ``schedule`` is ``'gpipe'``
+    (overlapped, ``M + P - 1`` serial stage-times) or ``'sequential'``
+    (``M * P``, the numerics baseline). The returned callable exposes
+    ``schedule_ticks``: the number of serial stage-computations in its
+    forward sweep.
     """
     if schedule not in ("gpipe", "sequential"):
         raise ValueError(f"unknown pp schedule {schedule!r}")
+    if task not in ("classifier", "lm"):
+        raise ValueError(f"unknown pp task {task!r}")
     has_dp = dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
+    causal = task == "lm"
     n_stages = mesh.shape[pp_axis]
     per = model.num_layers // n_stages
     M = n_microbatches
@@ -110,7 +119,7 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
 
         def body(carry, block):
             x, rng = carry
-            x, rng = model._block(block, x, None, False, True, rng)
+            x, rng = model._block(block, x, None, causal, True, rng)
             return (x, rng), None
 
         (x, rng), _ = jax.lax.scan(body, (x, rng), stage_blocks)
@@ -127,11 +136,24 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         x = x + shared["embed"]["pos"][:ids.shape[1]][None, :, :]
         return model.cast(x)
 
-    def head_loss(shared, x, y, m_idx, mb):
+    def _mb_slice(a, m_idx, mb):
+        return jax.lax.dynamic_slice_in_dim(
+            a, jnp.clip(m_idx, 0, M - 1) * mb, mb, axis=0)
+
+    def head_loss(shared, x, ids, y, m_idx, mb):
         """Mean loss of microbatch ``m_idx`` from final-stage activations."""
-        mi = jnp.clip(m_idx, 0, M - 1)
-        ym = jax.lax.dynamic_slice_in_dim(y, mi * mb, mb, axis=0)
         x = _layer_norm(x, shared["final_ln"]["scale"], shared["final_ln"]["bias"])
+        if task == "lm":
+            idsm = _mb_slice(ids, m_idx, mb).astype(jnp.int32)
+            w = _mb_slice(y, m_idx, mb)[:, 1:].astype(jnp.float32)
+            logits = jnp.matmul(x.astype(jnp.float32),
+                                shared["embed"]["tok"].T.astype(jnp.float32))
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            nll = -jnp.take_along_axis(logp, idsm[:, 1:, None], axis=-1)[..., 0]
+            per_ex = (jnp.sum(nll * w, axis=-1)
+                      / jnp.maximum(jnp.sum(w, axis=-1), 1e-6))
+            return jnp.mean(per_ex)
+        ym = _mb_slice(y, m_idx, mb)
         pooled = jnp.mean(x, axis=1).astype(jnp.float32)
         logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
         return jnp.mean(-jnp.sum(ym * jax.nn.log_softmax(logits, axis=-1), axis=-1))
@@ -158,7 +180,7 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             out = ckpt_stage(my_blocks, inp,
                              jax.random.fold_in(rng, t * n_stages + s))
             # the final stage finishes microbatch m_here this tick
-            lval = head_loss(shared, out, y, m_here, mb)
+            lval = head_loss(shared, out, ids, y, m_here, mb)
             live = (s == n_stages - 1) & (m_here >= 0) & (m_here < M)
             loss_acc = loss_acc + jnp.where(live, lval, 0.0)
             x_next = jax.lax.ppermute(out, pp_axis, ring)
@@ -195,15 +217,15 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             return jax.lax.ppermute(x, pp_axis, ring)
 
         x = jax.lax.fori_loop(0, n_stages, tick, x)
-        # after n_stages ticks the fully-processed activation is back on stage 0
-        x = _layer_norm(x, shared["final_ln"]["scale"], shared["final_ln"]["bias"])
-        pooled = jnp.mean(x, axis=1).astype(jnp.float32)
-        logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
-        per_ex = -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        # after n_stages ticks the fully-processed activation is back on
+        # stage 0; head_loss (which applies the final layer norm) with
+        # m_idx=0 and mb=rows reuses the task-specific head — the caller
+        # already sliced this microbatch
+        lval = head_loss(shared, x, ids, y, 0, ids.shape[0])
         # only stage 0 holds the real result: the LOCAL masked contribution
         # (no psum here — see gpipe_loss on why psum-in-the-loss inflates
         # gradients by P under shard_map autodiff)
-        return jnp.where(s == 0, jnp.mean(per_ex), 0.0)
+        return jnp.where(s == 0, lval, 0.0)
 
     param_specs = {"stages": P(pp_axis), "shared": P()}  # pytree prefixes
     data_spec = P(dp_axis) if has_dp else P()

@@ -389,3 +389,36 @@ def test_pp_composes_with_dp(pp_setup):
     for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(sgd_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-5)
+
+
+def test_pp_lm_task_matches_single_device():
+    """Pipeline-parallel causal LM (task='lm'): loss and the SGD update must
+    match the single-device transformer_lm on the same batch."""
+    import optax
+    spec = build_registry_spec("transformer_lm", vocab_size=40, hidden=32,
+                               num_layers=8, num_heads=4, mlp_dim=64,
+                               max_len=16, dropout=0.0)
+    m = model_from_json(spec)
+    params = m.init(jax.random.PRNGKey(0))
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    pp = shard_params(split_stage_params(m, params, 4), mesh,
+                      pp_pspecs(split_stage_params(m, params, 4)))
+    opt = build_optimizer("gradient_descent", 0.1, None)
+    step = make_pp_train_step(m, opt, mesh, n_microbatches=2, task="lm")
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, 40, (8, 16)), jnp.int32)
+    mask = jnp.ones((8, 16), jnp.float32)
+    p2, _, loss = step(pp, opt.init(pp), ids, mask, jax.random.PRNGKey(9))
+
+    def ref_loss(p):
+        return m.loss_vector(p, {"input_ids": ids, "attention_mask": mask},
+                             train=False).mean()
+
+    np.testing.assert_allclose(float(loss), float(ref_loss(params)),
+                               atol=1e-4)
+    g = jax.grad(ref_loss)(params)
+    sgd = optax.apply_updates(params, jax.tree.map(lambda x: -0.1 * x, g))
+    back = merge_stage_params(m, p2)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(sgd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
