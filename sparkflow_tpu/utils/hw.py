@@ -42,6 +42,15 @@ def ensure_live_backend(timeout_s: int = 120, retries: int = 1,
     """
     explicit_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     if explicit_cpu:
+        # the env var alone is NOT trustworthy: a TPU-plugin sitecustomize
+        # can override platform selection at import time, and first device
+        # use would then hang on a wedged accelerator anyway — honor the
+        # caller's intent in-process
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backends already initialized (then env/explicit cpu held)
         return False
     for attempt in range(max(1, retries)):
         if attempt and backoff_s:
