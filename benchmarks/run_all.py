@@ -141,43 +141,46 @@ def bench_flash_attention():
     S = 1024 if QUICK else 4096
     rs = np.random.RandomState(0)
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    n = 3
-    # inputs pre-generated and device-committed BEFORE timing (fresh per call
-    # to defeat relay memoization; generation/H2D must not pollute the timing)
-    inputs = [jax.block_until_ready(jnp.asarray(rs.randn(2, 8, S, 64), dtype))
-              for _ in range(2 * n + 2)]
+    # The axon relay has a ~72ms fixed sync round-trip and memoizes identical
+    # executions, so per-dispatch timing measures the relay, not the kernel.
+    # Amortize: lax.scan the op over ITERS pre-stacked fresh inputs inside ONE
+    # jit — a single dispatch+sync covers ITERS kernel invocations.
+    ITERS = 4 if QUICK else 16
 
-    f = jax.jit(lambda q: flash_attention(q, q, q, causal=True,
-                                          block_q=512, block_k=512).sum())
-    r = jax.jit(lambda q: attention_reference(q, q, q, causal=True).sum())
-    float(f(inputs[0])); float(r(inputs[1]))  # compile
-    t0 = time.perf_counter()
-    for i in range(n):
-        float(f(inputs[2 + i]))
-    tf = (time.perf_counter() - t0) / n
-    t0 = time.perf_counter()
-    for i in range(n):
-        float(r(inputs[2 + n + i]))
-    tr = (time.perf_counter() - t0) / n
+    def _fresh_stack():
+        # a NEW buffer per timed call: the relay memoizes identical
+        # (executable, args) executions, so the measured call must use inputs
+        # the warm-up call never saw
+        return jax.block_until_ready(
+            jnp.asarray(rs.randn(ITERS, 2, 8, S, 64), dtype))
+
+    def _timed(op):
+        @jax.jit
+        def many(xs):
+            def body(acc, q):
+                return acc + op(q), None
+            out, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), xs)
+            return out
+        float(many(_fresh_stack()))  # compile + warm
+        inp = _fresh_stack()
+        t0 = time.perf_counter()
+        float(many(inp))
+        return (time.perf_counter() - t0) / ITERS
+
+    tf = _timed(lambda q: flash_attention(q, q, q, causal=True, block_q=512,
+                                          block_k=512).astype(jnp.float32).sum())
+    tr = _timed(lambda q: attention_reference(q, q, q, causal=True)
+                .astype(jnp.float32).sum())
     _emit("flash_attention_vs_xla", tr / tf, "speedup_x",
           {"seq": S, "flash_ms": round(tf * 1e3, 2), "xla_ms": round(tr * 1e3, 2)})
 
     # fwd+bwd: the training-path comparison (pallas dq/dk/dv kernels vs
     # XLA autodiff of the dense reference)
-    fg = jax.jit(jax.grad(lambda q: flash_attention(
-        q, q, q, causal=True, block_q=512, block_k=512).sum()))
-    rg = jax.jit(jax.grad(lambda q: attention_reference(
-        q, q, q, causal=True).sum()))
-    jax.block_until_ready(fg(inputs[0]))
-    jax.block_until_ready(rg(inputs[1]))  # compile
-    t0 = time.perf_counter()
-    for i in range(n):
-        jax.block_until_ready(fg(inputs[2 + i]))
-    tfg = (time.perf_counter() - t0) / n
-    t0 = time.perf_counter()
-    for i in range(n):
-        jax.block_until_ready(rg(inputs[2 + n + i]))
-    trg = (time.perf_counter() - t0) / n
+    tfg = _timed(lambda q: jax.grad(lambda a: flash_attention(
+        a, a, a, causal=True, block_q=512, block_k=512).astype(jnp.float32)
+        .sum())(q).astype(jnp.float32).sum())
+    trg = _timed(lambda q: jax.grad(lambda a: attention_reference(a, a, a,
+        causal=True).astype(jnp.float32).sum())(q).astype(jnp.float32).sum())
     _emit("flash_attention_fwd_bwd_vs_xla", trg / tfg, "speedup_x",
           {"seq": S, "flash_ms": round(tfg * 1e3, 2),
            "xla_ms": round(trg * 1e3, 2)})

@@ -122,7 +122,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
         if mask_ref is not None:  # [1, block_k] key-padding mask for this batch row
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+            s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
 
         m_prev = m_ref[:]                          # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -146,9 +146,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
     @pl.when(ki == nk - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
-        # logsumexp per q row — the backward kernels recompute p from it
-        lse_ref[0] = (m_ref[:, 0]
-                      + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+        # logsumexp per q row — the backward kernels recompute p from it.
+        # Kept [block_q, 1]: a trailing unit dim makes the block legal under
+        # the TPU (8, 128) tile rule (a [1, block_q] block is not)
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
@@ -170,18 +171,19 @@ def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
     ]
     args = [qf, kf, vf]
     if has_mask:
-        # per-batch key mask [B, Sk]; block row selected by bh // h
-        in_specs.append(pl.BlockSpec((1, block_k),
-                                     lambda bh, qi, ki, _h=h: (bh // _h, ki)))
-        args.append(kv_mask.astype(jnp.float32))
+        # per-batch key mask as [B, 1, Sk]; block row selected by bh // h
+        # (the unit middle dim keeps the [1, 1, block_k] block tile-legal)
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda bh, qi, ki, _h=h: (bh // _h, 0, ki)))
+        args.append(kv_mask.astype(jnp.float32)[:, None, :])
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, sk // block_k),
         in_specs=in_specs,
         out_specs=(pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-                   pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi))),
+                   pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))),
         out_shape=(jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, s), jnp.float32)),
+                   jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -195,6 +197,14 @@ def _flash_pallas_forward(q, k, v, kv_mask, causal, scale, block_q, block_k,
     if with_lse:
         return out, lse.reshape(b, h, s)
     return out
+
+
+# Tile-legal [1, block, 1] block over a [bh, s, 1] row-statistics array —
+# shared by the lse/delta operands of the forward and backward kernels
+def _row_stat_spec(block, order="qk"):
+    if order == "qk":   # grid (bh, qi, ki)
+        return pl.BlockSpec((1, block, 1), lambda bh_, qi, ki: (bh_, qi, 0))
+    return pl.BlockSpec((1, block, 1), lambda bh_, ki, qi: (bh_, qi, 0))
 
 
 def _blockwise_attention(q, k, v, kv_mask, causal, scale, block_k=512):
@@ -243,19 +253,20 @@ def _blockwise_attention(q, k, v, kv_mask, causal, scale, block_k=512):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_p_block(q, k, lse, sm_scale, causal, qi0, ki0, mask_vec):
+def _bwd_p_block(q, k, lse, sm_scale, causal, qi0, ki0, mask_blk):
     """Recompute the normalized probability block P = exp(S - L) [bq, bk];
-    masked/causal-excluded entries are exactly 0 (no exp of NEG_INF deltas)."""
+    masked/causal-excluded entries are exactly 0 (no exp of NEG_INF deltas).
+    ``lse`` is [bq, 1]; ``mask_blk`` is [1, bk] (both broadcast over S)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi0
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki0
         s = jnp.where(rows >= cols, s, NEG_INF)
-    if mask_vec is not None:
-        s = jnp.where(mask_vec[None, :] > 0, s, NEG_INF)
+    if mask_blk is not None:
+        s = jnp.where(mask_blk > 0, s, NEG_INF)
     # rows with every key masked have lse ~ NEG_INF; gate on s to keep p = 0
-    return jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - lse[:, None]), 0.0)
+    return jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - lse), 0.0)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -284,7 +295,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0])
         dq_acc[:] += sm_scale * jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -331,7 +342,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0])
         dk_acc[:] += sm_scale * jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -357,17 +368,19 @@ def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
     bh = b * h
     qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
     gf = g.reshape(bh, s, d)
-    lsef = lse.reshape(bh, s)
+    # row statistics travel as [bh, s, 1] so their [1, block_q, 1] blocks are
+    # tile-legal (same layout the forward emits lse in)
+    lsef = lse.reshape(bh, s, 1)
     # D_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it fine
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, s)
+                    axis=-1).reshape(bh, s, 1)
     has_mask = kv_mask is not None
-    maskf = kv_mask.astype(jnp.float32) if has_mask else None
+    maskf = kv_mask.astype(jnp.float32)[:, None, :] if has_mask else None
 
     common = dict(sm_scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, has_mask=has_mask)
     qspec = pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0))
-    row_q = pl.BlockSpec((1, block_q), lambda bh_, qi, ki: (bh_, qi))
+    row_q = _row_stat_spec(block_q, "qk")
 
     in_specs_dq = [
         qspec,
@@ -378,7 +391,7 @@ def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
     args_dq = [qf, kf, vf, gf, lsef, delta]
     if has_mask:
         in_specs_dq.append(pl.BlockSpec(
-            (1, block_k), lambda bh_, qi, ki, _h=h: (bh_ // _h, ki)))
+            (1, 1, block_k), lambda bh_, qi, ki, _h=h: (bh_ // _h, 0, ki)))
         args_dq.append(maskf)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -397,13 +410,13 @@ def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
         pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0)),
         kspec, kspec,
         pl.BlockSpec((1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, block_q), lambda bh_, ki, qi: (bh_, qi)),
-        pl.BlockSpec((1, block_q), lambda bh_, ki, qi: (bh_, qi)),
+        _row_stat_spec(block_q, "kq"),
+        _row_stat_spec(block_q, "kq"),
     ]
     args_kv = [qf, kf, vf, gf, lsef, delta]
     if has_mask:
         in_specs_kv.append(pl.BlockSpec(
-            (1, block_k), lambda bh_, ki, qi, _h=h: (bh_ // _h, ki)))
+            (1, 1, block_k), lambda bh_, ki, qi, _h=h: (bh_ // _h, 0, ki)))
         args_kv.append(maskf)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common),

@@ -20,6 +20,7 @@ import compatibility with reference user code.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Optional
 
 import numpy as np
@@ -34,6 +35,8 @@ from .optimizers import build_optimizer_from_json
 from .parallel.mesh import default_mesh
 from .pipeline_util import PysparkReaderWriter
 from .trainer import Trainer
+
+logger = logging.getLogger("sparkflow_tpu")
 
 
 def _split_csv(s: Optional[str]) -> list:
@@ -369,6 +372,20 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if extra_cols and fit_mode == "stream":
             raise ValueError("fitMode='stream' supports a single input "
                              "column; use collect mode for multi-input models")
+        # Documented no-ops (there is no parameter server): warn so a config
+        # carried over from the reference states its own inertness instead of
+        # silently passing (tests assert these warnings — the API contract is
+        # "accepted, warned, ignored").
+        if self.getAcquireLock():
+            logger.warning(
+                "acquireLock=True has no effect: synchronous all-reduce "
+                "updates are already serialized (no Hogwild parameter server "
+                "exists to lock)")
+        if self.isSet(self.port):
+            logger.warning(
+                "port=%d has no effect: there is no parameter server to bind "
+                "a port for (weights never leave the device mesh)",
+                self.getPort())
         return fit_mode, extra_cols, extra_inputs
 
     def _fit(self, dataset):
@@ -445,8 +462,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             # reference's self-contained inline JSON, the file must be visible
             # to every executor/machine that transforms or loads the pipeline
             # (use a shared filesystem path).
-            import logging
-            logging.getLogger("sparkflow_tpu").warning(
+            logger.warning(
                 "weightsPath=%s: model references a filesystem path; ensure it "
                 "is reachable from all executors and travels with saved "
                 "pipelines", weights_path)

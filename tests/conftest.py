@@ -11,18 +11,25 @@ the platform must happen via jax.config before any device use.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Escape hatch: SPARKFLOW_TEST_PLATFORM=native leaves the real backend (axon
+# TPU) in place so the @skipif(backend != 'tpu') hardware tests can actually
+# run — without it the cpu forcing below makes them permanently dead code.
+_NATIVE = os.environ.get("SPARKFLOW_TEST_PLATFORM", "cpu") == "native"
+
+if not _NATIVE:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass  # already initialized with the right settings (e.g. driver-run)
+if not _NATIVE:
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass  # already initialized with the right settings (e.g. driver-run)
 
 import numpy as np
 import pytest

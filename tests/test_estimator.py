@@ -185,10 +185,31 @@ def test_auto_encoder(spark):
     assert len(encoded[0]["predicted"]) == 2  # bottleneck width
 
 
-def test_change_port(spark, gaussian_df):
-    """port is accepted for API compatibility (no server exists to bind it)."""
+def test_change_port(spark, gaussian_df, caplog):
+    """port is accepted for API compatibility (no server exists to bind it);
+    the documented contract is accepted-warned-ignored, so assert the
+    warning, not just that fit works (the reference binds Flask to the port,
+    ``HogwildSparkModel.py:244``)."""
+    import logging
+
     mg = build_graph(create_model)
-    model = base_estimator(mg, port=3000, iters=15).fit(gaussian_df)
+    with caplog.at_level(logging.WARNING, logger="sparkflow_tpu"):
+        model = base_estimator(mg, port=3000, iters=15).fit(gaussian_df)
+    assert any("port=3000 has no effect" in r.message for r in caplog.records)
+    assert calculate_errors(model.transform(gaussian_df)) < 400
+
+
+def test_acquire_lock_warns_no_op(spark, gaussian_df, caplog):
+    """acquireLock maps to the reference's RWLock-serialized PS updates
+    (``tensorflow_async.py:115``); here sync all-reduce already serializes
+    updates, so the Param warns that it is inert."""
+    import logging
+
+    mg = build_graph(create_model)
+    with caplog.at_level(logging.WARNING, logger="sparkflow_tpu"):
+        model = base_estimator(mg, acquireLock=True, iters=15).fit(gaussian_df)
+    assert any("acquireLock=True has no effect" in r.message
+               for r in caplog.records)
     assert calculate_errors(model.transform(gaussian_df)) < 400
 
 

@@ -237,3 +237,70 @@ def test_ring_flash_kv_mask_path(dp_mesh):
         # in garbage content; compare only rows with any visible key
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, err_msg=f"causal={causal}")
+
+
+def test_flash_block_specs_tile_legal():
+    """Every pallas block mapping must satisfy the TPU tile rule: the last two
+    block dims divisible by (8, 128) or equal to the array dims. The lse
+    output / lse+delta operands and the kv mask used to travel as 2-D arrays
+    with [1, block] blocks, which lowers fine in interpret mode but fails
+    _check_block_mappings on real TPU hardware (caught live at BERT-512
+    shapes). Row stats now travel as [bh, s, 1], the mask as [b, 1, sk];
+    this pins the layout rule without needing a TPU."""
+    from sparkflow_tpu.ops import attention as A
+
+    def legal(block, array):
+        for pos, (bdim, adim) in enumerate(zip(block[-2:], array[-2:])):
+            div = (8, 128)[pos]  # sublane rule for dim -2, lane rule for -1
+            if bdim != adim and bdim % div:
+                return False
+        return True
+
+    bh, s, bq, bk, b, h = 6, 512, 128, 128, 2, 3
+    # forward lse output layout
+    assert legal((1, bq, 1), (bh, s, 1))
+    # backward row-stat operands share the same layout
+    spec = A._row_stat_spec(bq, "qk")
+    assert spec.block_shape == (1, bq, 1)
+    assert A._row_stat_spec(bq, "kq").index_map(4, 1, 2) == (4, 2, 0)
+    # the kv mask travels [b, 1, sk] with [1, 1, block_k] blocks
+    assert legal((1, 1, bk), (b, 1, s))
+    # the old layouts are the regression: [1, block] over [bh, s] is illegal
+    assert not legal((1, bq), (bh, s))
+
+
+def test_flash_kv_mask_batched_rows(qkv):
+    """Mask rows must be selected per batch (bh // h), exercising the 3-D
+    [b, 1, sk] mask layout with b > 1 and distinct per-row masks."""
+    q, k, v = qkv
+    rs = np.random.RandomState(3)
+    mask = jnp.asarray((rs.rand(q.shape[0], q.shape[2]) > 0.3)
+                       .astype(np.float32))
+    out = flash_attention(q, k, v, kv_mask=mask, interpret=True)
+    ref = attention_reference(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    g = jax.grad(lambda a: flash_attention(a, k, v, kv_mask=mask,
+                                           interpret=True).sum())(q)
+    gr = jax.grad(lambda a: attention_reference(a, k, v, kv_mask=mask)
+                  .sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=3e-4)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="real-TPU pallas lowering check")
+def test_flash_lowers_on_tpu():  # pragma: no cover (CPU suite skips)
+    """Compile the non-interpret kernels at BERT-ish shapes: the exact path
+    that failed the (8, 128) tile check before the 3-D row-stat layout."""
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(4, 12, 512, 64), jnp.float32)
+    mask = jnp.asarray((rs.rand(4, 512) > 0.1).astype(np.float32))
+    for causal in (False, True):
+        for m in (None, mask):
+            o = flash_attention(q, q, q, causal=causal, kv_mask=m,
+                                interpret=False)
+            r = attention_reference(q, q, q, causal=causal, kv_mask=m)
+            assert float(jnp.linalg.norm((o - r).ravel())
+                         / jnp.linalg.norm(r.ravel())) < 5e-3
+            g = jax.grad(lambda a: flash_attention(
+                a, a, a, causal=causal, kv_mask=m, interpret=False).sum())(q)
+            assert bool(jnp.all(jnp.isfinite(g)))
