@@ -24,10 +24,12 @@ bench-quick:
 baseline:
 	python bench_baseline.py
 
+# PYTHONPATH must APPEND the repo root: replacing it would clobber the axon
+# TPU plugin's site dir (see .claude/skills/verify/SKILL.md gotchas)
 examples:
-	cd examples && SPARKFLOW_TPU_SMOKE=1 python simple_dnn.py && \
-	SPARKFLOW_TPU_SMOKE=1 python cnn_example.py && \
-	SPARKFLOW_TPU_SMOKE=1 python autoencoder_example.py
+	cd examples && PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python simple_dnn.py && \
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python cnn_example.py && \
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python autoencoder_example.py
 
 native:
 	python -c "from sparkflow_tpu.native.build import load_library; \
@@ -39,6 +41,6 @@ clean:
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:
-	cd examples && SPARKFLOW_TPU_SMOKE=1 python text_classifier.py && \
-	SPARKFLOW_TPU_SMOKE=1 python bert_classifier.py && \
-	SPARKFLOW_TPU_SMOKE=1 python tf1_migration.py
+	cd examples && PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python text_classifier.py && \
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python bert_classifier.py && \
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python tf1_migration.py

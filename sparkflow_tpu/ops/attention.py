@@ -599,9 +599,11 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     contribution) — so the kernel never needs global offsets.
 
     Falls back to :func:`ring_attention` when shapes don't satisfy the
-    kernel's tiling constraints. The backward is a jnp-ring RECOMPUTE (custom
-    VJP over the whole ring, per-visit remat) — the pallas backward kernels
-    are not involved on this path; the kernel win applies to the forward.
+    kernel's tiling constraints. The backward is ALSO a pallas ring: per
+    visit the dq/dk/dv kernels recompute P from the forward's merged global
+    logsumexp, and the dk/dv accumulators rotate with their k/v shard (see
+    :func:`_ring_flash_backward`) — the kernel win covers training, not just
+    the forward.
     """
     b, h, sl, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -621,7 +623,7 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
-                        interpret):
+                        interpret, with_lse=False):
     b, h, sl, d = q.shape
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -666,7 +668,64 @@ def _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
             k, v,
             kv_mask if have_mask else jnp.zeros((b, sl), jnp.float32))
     o, lse, _, _, _ = jax.lax.fori_loop(0, n, body, init)
+    if with_lse:
+        return o.astype(q.dtype), lse
     return o.astype(q.dtype)
+
+
+def _ring_flash_backward(q, k, v, kv_mask, out, lse, g, axis_name, causal,
+                         scale, bq, bk, interpret):
+    """Ring backward running the PALLAS dq/dk/dv kernels per visit.
+
+    The forward's merged ``lse`` is the GLOBAL logsumexp for every local q row,
+    so per-visit kernel calls with it recompute globally-normalized P blocks
+    directly — each visit's dq/dk/dv contribution is exact, and contributions
+    just sum. dk/dv accumulators ROTATE WITH their k/v shard: after n
+    ppermutes they arrive home having collected every device's contribution.
+    Same three-case causal structure as the forward (strictly-ahead sources
+    contribute zero and skip the kernels entirely)."""
+    b, h, sl, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    have_mask = kv_mask is not None
+
+    def visit(kc, vc, mc, local_causal):
+        dq2, dk2, dv2 = _flash_pallas_backward(
+            q, kc, vc, mc if have_mask else None, out, lse, g, local_causal,
+            scale, bq, bk, interpret)
+        return (dq2.astype(jnp.float32), dk2.astype(jnp.float32),
+                dv2.astype(jnp.float32))
+
+    def body(step, carry):
+        dq, kc, vc, mc, dk, dv = carry
+        src = (idx - step) % n
+        if causal:
+            branch = jnp.where(src == idx, 1, jnp.where(src > idx, 2, 0))
+            dq2, dk2, dv2 = jax.lax.switch(branch, [
+                lambda: visit(kc, vc, mc, False),
+                lambda: visit(kc, vc, mc, True),
+                lambda: (jnp.zeros((b, h, sl, d), jnp.float32),) * 3,
+            ])
+        else:
+            dq2, dk2, dv2 = visit(kc, vc, mc, False)
+        dq = dq + dq2
+        dk = dk + dk2
+        dv = dv + dv2
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        if have_mask:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        return dq, kc, vc, mc, dk, dv
+
+    zeros = jnp.zeros((b, h, sl, d), jnp.float32)
+    init = (zeros, k, v,
+            kv_mask if have_mask else jnp.zeros((b, sl), jnp.float32),
+            zeros, zeros)
+    dq, _, _, _, dk, dv = jax.lax.fori_loop(0, n, body, init)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
@@ -677,23 +736,20 @@ def _ring_flash(q, k, v, kv_mask, axis_name, causal, scale, bq, bk, interpret):
 
 def _ring_flash_fwd(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
                     interpret):
-    out = _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale,
-                              bq, bk, interpret)
-    return out, (q, k, v, kv_mask)
+    out, lse = _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale,
+                                   bq, bk, interpret, with_lse=True)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, scale, bq, bk, interpret, res, g):
-    # recompute-style backward through the differentiable jnp ring (the
-    # ppermute transposes to the reverse ring automatically) — the same
-    # recompute pattern the flash kernel itself used before its pallas
-    # backward landed; keeps memory bounded and gradients exact
-    q, k, v, kv_mask = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: ring_attention(q, k, v, axis_name, causal=causal,
-                                       sm_scale=scale, kv_mask=kv_mask),
-        q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+    # pallas dq/dk/dv kernels per ring visit (see _ring_flash_backward) — the
+    # kernel win now covers the training path, not just the forward; memory
+    # stays O(S/n) per device (lse + out residuals, per-visit recompute of P)
+    q, k, v, kv_mask, out, lse = res
+    dq, dk, dv = _ring_flash_backward(q, k, v, kv_mask, out, lse, g,
+                                      axis_name, causal, scale, bq, bk,
+                                      interpret)
+    return dq, dk, dv, None  # mask carries no gradient
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

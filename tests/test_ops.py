@@ -238,6 +238,25 @@ def test_ring_flash_kv_mask_path(dp_mesh):
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, err_msg=f"causal={causal}")
 
+        # gradients through the pallas ring backward with the mask rotating
+        # alongside the dk/dv accumulators
+        def loss(a, b_, c):
+            return shard_map(lambda q_, k_, v_, m_: ring_fn(q_, k_, v_, m_),
+                             mesh=dp_mesh,
+                             in_specs=(P(None, None, "dp", None),) * 3
+                             + (P(None, "dp"),),
+                             out_specs=P(None, None, "dp", None),
+                             check_vma=False)(a, b_, c, mask).sum()
+
+        gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b_, c: attention_reference(
+            a, b_, c, causal=causal, kv_mask=mask).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-3,
+                                       err_msg=f"mask grads causal={causal}")
+
 
 def test_flash_block_specs_tile_legal():
     """Every pallas block mapping must satisfy the TPU tile rule: the last two
