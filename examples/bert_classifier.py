@@ -47,6 +47,10 @@ def synthetic_text(spark, n, seq_len, vocab):
 
 
 if __name__ == "__main__":
+    # a wedged TPU relay must not hang the demo: probe the
+    # backend and fall back to CPU (same guard bench.py uses)
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
     spark = SparkSession.builder.appName("bert-classifier").getOrCreate()
     seq_len = 64 if SMOKE else 512
     vocab = 1000 if SMOKE else 30522

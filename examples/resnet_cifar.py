@@ -35,6 +35,10 @@ def synthetic_cifar(spark, n=512):
 
 
 if __name__ == "__main__":
+    # a wedged TPU relay must not hang the demo: probe the
+    # backend and fall back to CPU (same guard bench.py uses)
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
     smoke = bool(os.environ.get("SPARKFLOW_TPU_SMOKE"))
     spark = SparkSession.builder.appName("resnet-cifar").getOrCreate()
     n = 64 if smoke else 2048

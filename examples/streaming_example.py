@@ -30,6 +30,10 @@ def row_stream(n_rows=20000, dim=128, seed=0):
 
 
 if __name__ == "__main__":
+    # a wedged TPU relay must not hang the demo: probe the
+    # backend and fall back to CPU (same guard bench.py uses)
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
     tr = Trainer(build_graph(model), "x:0", "y:0", mini_batch_size=256,
                  learning_rate=0.05)
     res = tr.fit_stream(row_stream())

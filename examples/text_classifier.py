@@ -43,6 +43,10 @@ def synthetic_reviews(n, rs):
 
 
 if __name__ == "__main__":
+    # a wedged TPU relay must not hang the demo: probe the
+    # backend and fall back to CPU (same guard bench.py uses)
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
     spark = SparkSession.builder.appName("text-classifier").getOrCreate()
     rs = np.random.RandomState(0)
     seq_len = 16

@@ -55,6 +55,10 @@ def make_legacy_artifacts(tmp="/tmp/sparkflow_tf1_demo"):
 
 
 if __name__ == "__main__":
+    # a wedged TPU relay must not hang the demo: probe the
+    # backend and fall back to CPU (same guard bench.py uses)
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
     from sparkflow_tpu.compat import USING_PYSPARK
     if USING_PYSPARK:
         from pyspark.sql import SparkSession

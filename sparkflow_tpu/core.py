@@ -120,12 +120,16 @@ def _sharded_trace_guard(fn: Callable, mesh: Mesh) -> Callable:
 
 
 def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                    mesh: Optional[Mesh] = None) -> Callable:
+                    mesh: Optional[Mesh] = None,
+                    infer_params: bool = False) -> Callable:
     """One jitted optimizer step.
 
     Signature: ``step(params, opt_state, x, y, mask, rng) ->
     (params, opt_state, loss)``. With a mesh, the batch is sharded over 'dp' and
-    XLA all-reduces gradients over ICI.
+    XLA all-reduces gradients over ICI. ``infer_params=True`` takes param /
+    opt-state shardings from the arrays themselves (tp/fsdp-placed params via
+    :func:`~sparkflow_tpu.parallel.tp.shard_params`) instead of pinning them
+    replicated.
     """
     step = _step_body(loss_fn, optimizer)
 
@@ -135,27 +139,33 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     step = _sharded_trace_guard(step, mesh)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("dp"))
+    pspec = None if infer_params else repl
     return jax.jit(
         step,
-        in_shardings=(repl, repl, data, data, data, repl),
-        out_shardings=(repl, repl, repl),
+        in_shardings=(pspec, pspec, data, data, data, repl),
+        out_shardings=(pspec, pspec, repl),
         donate_argnums=(0, 1),
     )
 
 
-def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh]) -> Callable:
+def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh],
+                    infer_params: bool = False) -> Callable:
     """Shared jit wrapper for epoch-shaped programs
-    ``fn(params, opt_state, data, labels, mask, rng)``."""
+    ``fn(params, opt_state, data, labels, mask, rng)``. ``infer_params=True``
+    leaves param/opt-state shardings to be inferred from the argument arrays
+    (sharded-parameter training: tp/fsdp); the default pins them replicated
+    (pure dp)."""
     if mesh is None:
         return jax.jit(fn, donate_argnums=(0, 1))
     fn = _sharded_trace_guard(fn, mesh)
     repl = NamedSharding(mesh, P())
     rows = NamedSharding(mesh, P("dp"))  # dataset rows sharded over dp; XLA
     # re-shards each scanned batch and all-reduces gradients over ICI
+    pspec = None if infer_params else repl
     return jax.jit(
         fn,
-        in_shardings=(repl, repl, rows, rows, rows, repl),
-        out_shardings=(repl, repl, repl),
+        in_shardings=(pspec, pspec, rows, rows, rows, repl),
+        out_shardings=(pspec, pspec, repl),
         donate_argnums=(0, 1),
     )
 
@@ -163,7 +173,8 @@ def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh]) -> Callable:
 def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   batch_size: int, num_batches: int, mode: str,
                   shuffle: bool, mesh: Optional[Mesh] = None,
-                  n_real: Optional[int] = None, _raw: bool = False) -> Callable:
+                  n_real: Optional[int] = None, _raw: bool = False,
+                  infer_params: bool = False) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -237,7 +248,7 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
     if _raw:
         return epoch
-    return _jit_epoch_like(epoch, mesh)
+    return _jit_epoch_like(epoch, mesh, infer_params)
 
 
 def make_multi_epoch_fn(loss_fn: Callable,
@@ -245,7 +256,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
                         batch_size: int, num_batches: int, mode: str,
                         shuffle: bool, n_epochs: int,
                         mesh: Optional[Mesh] = None,
-                        n_real: Optional[int] = None) -> Callable:
+                        n_real: Optional[int] = None,
+                        infer_params: bool = False) -> Callable:
     """``n_epochs`` whole epochs as ONE compiled program (``lax.scan`` over
     the epoch body): a full ``fit`` becomes a single device dispatch.
 
@@ -274,7 +286,7 @@ def make_multi_epoch_fn(loss_fn: Callable,
             step, (params, opt_state), erngs)
         return params, opt_state, losses
 
-    return _jit_epoch_like(run, mesh)
+    return _jit_epoch_like(run, mesh, infer_params)
 
 
 def pad_to_batches(x: np.ndarray, batch_size: int,

@@ -361,21 +361,43 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
-                           block_q, block_k, interpret):
+def _flash_bwd_prep(q, out, lse, g):
+    """Flatten the q-side operands and compute D = rowsum(dO * O) — all
+    independent of the k/v side, so ring backward hoists this out of the
+    per-visit loop. Row statistics travel as [bh, s, 1]: tile-legal
+    [1, block_q, 1] blocks (the layout the forward emits lse in)."""
     b, h, s, d = q.shape
-    sk = k.shape[2]
     bh = b * h
-    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
+    qf = q.reshape(bh, s, d)
     gf = g.reshape(bh, s, d)
-    # row statistics travel as [bh, s, 1] so their [1, block_q, 1] blocks are
-    # tile-legal (same layout the forward emits lse in)
     lsef = lse.reshape(bh, s, 1)
     # D_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it fine
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, s, 1)
-    has_mask = kv_mask is not None
-    maskf = kv_mask.astype(jnp.float32)[:, None, :] if has_mask else None
+    return qf, gf, lsef, delta
+
+
+def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
+                           block_q, block_k, interpret):
+    qf, gf, lsef, delta = _flash_bwd_prep(q, out, lse, g)
+    b, h, _, d = q.shape
+    kf = k.reshape(b * h, -1, d)
+    vf = v.reshape(b * h, -1, d)
+    maskf = (kv_mask.astype(jnp.float32)[:, None, :]
+             if kv_mask is not None else None)
+    dq, dk, dv = _flash_pallas_backward_flat(
+        qf, kf, vf, gf, lsef, delta, maskf, h, causal, scale,
+        block_q, block_k, interpret)
+    s, sk = q.shape[2], k.shape[2]
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+def _flash_pallas_backward_flat(qf, kf, vf, gf, lsef, delta, maskf, h,
+                                causal, scale, block_q, block_k, interpret):
+    bh, s, d = qf.shape
+    sk = kf.shape[1]
+    has_mask = maskf is not None
 
     common = dict(sm_scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, has_mask=has_mask)
@@ -398,7 +420,7 @@ def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
         grid=(bh, s // block_q, sk // block_k),
         in_specs=in_specs_dq,
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qf.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -423,16 +445,15 @@ def _flash_pallas_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
         grid=(bh, sk // block_k, s // block_q),
         in_specs=in_specs_kv,
         out_specs=(kspec, kspec),
-        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), vf.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args_kv)
-    return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -690,12 +711,18 @@ def _ring_flash_backward(q, k, v, kv_mask, out, lse, g, axis_name, causal,
     perm = [(i, (i + 1) % n) for i in range(n)]
     have_mask = kv_mask is not None
 
+    # q-side quantities (flat views + D = rowsum(dO*O)) never change across
+    # visits — computed ONCE outside the ring loop
+    qf, gf, lsef, delta = _flash_bwd_prep(q, out, lse, g)
+
     def visit(kc, vc, mc, local_causal):
-        dq2, dk2, dv2 = _flash_pallas_backward(
-            q, kc, vc, mc if have_mask else None, out, lse, g, local_causal,
-            scale, bq, bk, interpret)
-        return (dq2.astype(jnp.float32), dk2.astype(jnp.float32),
-                dv2.astype(jnp.float32))
+        dq2, dk2, dv2 = _flash_pallas_backward_flat(
+            qf, kc.reshape(b * h, sl, d), vc.reshape(b * h, sl, d), gf, lsef,
+            delta, mc.astype(jnp.float32)[:, None, :] if have_mask else None,
+            h, local_causal, scale, bq, bk, interpret)
+        return (dq2.reshape(b, h, sl, d).astype(jnp.float32),
+                dk2.reshape(b, h, sl, d).astype(jnp.float32),
+                dv2.reshape(b, h, sl, d).astype(jnp.float32))
 
     def body(step, carry):
         dq, kc, vc, mc, dk, dv = carry

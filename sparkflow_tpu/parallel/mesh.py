@@ -44,3 +44,34 @@ def mesh_axis_size(mesh: Optional[Mesh], axis: str) -> int:
     if mesh is None or axis not in mesh.axis_names:
         return 1
     return mesh.shape[axis]
+
+
+def parse_mesh_shape(s: str) -> Dict[str, int]:
+    """Parse a mesh-shape string Param like ``"dp=2,tp=4"`` into axis sizes.
+
+    Accepted axes: dp, tp, fsdp, sp, pp, ep. One axis may be ``-1``
+    (remaining devices, like :func:`make_mesh`). This is the estimator-facing
+    config format — a plain string so it persists like every reference Param.
+    """
+    known = ("dp", "tp", "fsdp", "sp", "pp", "ep")
+    axes: Dict[str, int] = {}
+    for part in (p.strip() for p in s.split(",") if p.strip()):
+        if "=" not in part:
+            raise ValueError(
+                f"meshShape entry {part!r} is not 'axis=size' (got {s!r})")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in known:
+            raise ValueError(
+                f"unknown mesh axis {name!r} in meshShape {s!r}; "
+                f"known axes: {', '.join(known)}")
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r} in {s!r}")
+        try:
+            axes[name] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"mesh axis size {size!r} for {name!r} is not an integer")
+    if not axes:
+        raise ValueError(f"empty meshShape {s!r}")
+    return axes

@@ -78,3 +78,32 @@ def make_sharded_train_step(model, optimizer, mesh: Mesh, input_name: str,
     return jax.jit(step,
                    in_shardings=(None, None, data, data, data, repl),
                    donate_argnums=(0, 1))
+
+
+def derive_param_pspecs(model, mesh: Mesh):
+    """Parameter PartitionSpecs for training ``model`` on ``mesh``.
+
+    - mesh has ``tp``/``ep`` and the model publishes megatron-style rules
+      (``param_pspecs``, transformer/resnet/moe families) -> those rules
+      (axes absent from the mesh degrade to replication via
+      :func:`filter_pspec` inside :func:`shard_params`);
+    - mesh has ``fsdp`` -> ZeRO-style :func:`fsdp_pspecs` derived from the
+      model's ``param_specs()`` — works for ANY model incl. the ``nn``-DSL
+      graphs (largest dim of every big tensor shards, small ones replicate);
+    - otherwise (pure dp) -> ``None``: replicate params, shard the batch.
+    """
+    has_tp = any(a in mesh.axis_names for a in ("tp", "ep"))
+    has_fsdp = "fsdp" in mesh.axis_names
+    if has_tp and has_fsdp:
+        # auto-composing megatron rules WITH ZeRO sharding needs per-tensor
+        # axis assignments no heuristic can guess; refusing beats silently
+        # replicating one of the two requested shardings
+        raise ValueError(
+            "combined tp/ep + fsdp sharding cannot be auto-derived; pass an "
+            "explicit PartitionSpec pytree (Trainer(param_sharding=...)) or "
+            "drop one of the axes")
+    if has_tp and hasattr(model, "param_pspecs"):
+        return model.param_pspecs()
+    if has_fsdp and hasattr(model, "param_specs"):
+        return fsdp_pspecs(model.param_specs(), axis="fsdp")
+    return None

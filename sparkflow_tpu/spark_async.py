@@ -32,7 +32,7 @@ from .graphdef import GraphModel
 from .localml.linalg import vector_to_array
 from .ml_util import (convert_weights_to_json, handle_features, predict_func)
 from .optimizers import build_optimizer_from_json
-from .parallel.mesh import default_mesh
+from .parallel.mesh import default_mesh, make_mesh
 from .pipeline_util import PysparkReaderWriter
 from .trainer import Trainer
 
@@ -193,6 +193,10 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # extra (column, tensor) feeds for multi-input models (see the Model)
     extraInputCols = Param(Params._dummy(), "extraInputCols", "", typeConverter=TypeConverters.toString)
     extraTfInputs = Param(Params._dummy(), "extraTfInputs", "", typeConverter=TypeConverters.toString)
+    # upgrade: device-mesh shape as a plain string ("dp=2,tp=4",
+    # "dp=2,fsdp=4", ...) so multi-strategy parallelism is reachable from the
+    # Param surface; unset -> all local devices on one 'dp' axis
+    meshShape = Param(Params._dummy(), "meshShape", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -222,7 +226,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  checkpointEvery=None,
                  fitMode=None,
                  extraInputCols=None,
-                 extraTfInputs=None):
+                 extraTfInputs=None,
+                 meshShape=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -239,7 +244,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          partitionShuffles=1, optimizerOptions=None, port=5000,
                          weightsPath=None, checkpointDir=None, checkpointEvery=0,
                          fitMode='collect', extraInputCols=None,
-                         extraTfInputs=None)
+                         extraTfInputs=None, meshShape=None)
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -272,7 +277,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   checkpointEvery=None,
                   fitMode=None,
                   extraInputCols=None,
-                  extraTfInputs=None):
+                  extraTfInputs=None,
+                  meshShape=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -339,6 +345,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     def getPort(self):
         return self.getOrDefault(self.port)
 
+    def getMeshShape(self):
+        return _opt_param(self, self.meshShape)
+
     def getFitMode(self):
         return _opt_param(self, self.fitMode, "collect")
 
@@ -372,6 +381,23 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if extra_cols and fit_mode == "stream":
             raise ValueError("fitMode='stream' supports a single input "
                              "column; use collect mode for multi-input models")
+        mesh_axes = None
+        mesh_shape = self.getMeshShape()
+        if mesh_shape:
+            from .parallel.mesh import parse_mesh_shape
+            mesh_axes = parse_mesh_shape(mesh_shape)  # raises on bad syntax
+            bad = [a_ for a_ in mesh_axes if a_ in ("sp", "pp")]
+            if bad:
+                raise ValueError(
+                    "meshShape axes %s are not estimator strategies "
+                    "(sequence/pipeline parallelism need the dedicated step "
+                    "builders in sparkflow_tpu.parallel); the estimator "
+                    "trains dp/tp/fsdp/ep meshes" % bad)
+            if "dp" not in mesh_axes:
+                # the compiled epochs shard dataset rows over 'dp'; a size-1
+                # axis makes e.g. "fsdp=8" mean "all devices shard params,
+                # none shard data" instead of a deep GSPMD error
+                mesh_axes = {"dp": 1, **mesh_axes}
         # Documented no-ops (there is no parameter server): warn so a config
         # carried over from the reference states its own inertness instead of
         # silently passing (tests assert these warnings — the API contract is
@@ -386,7 +412,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 "port=%d has no effect: there is no parameter server to bind "
                 "a port for (weights never leave the device mesh)",
                 self.getPort())
-        return fit_mode, extra_cols, extra_inputs
+        return fit_mode, extra_cols, extra_inputs, mesh_axes
 
     def _fit(self, dataset):
         inp_col = self.getOrDefault(self.inputCol)
@@ -394,7 +420,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         label_col = self.getOrDefault(self.labelCol)
         tf_label = self.getTfLabel()
         optimizer_options = self.getOptimizerOptions()
-        fit_mode, extra_cols, extra_inputs = self._validate_params()
+        fit_mode, extra_cols, extra_inputs, mesh_axes = self._validate_params()
 
         # DataFrame -> (features, label) pairs; partitions Param shapes the RDD
         # exactly as the reference does (tensorflow_async.py:290-291). In
@@ -426,7 +452,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             loss_callback=self._loss_callback,
             dropout_name=self.getTfDropout(),
             acquire_lock=self.getAcquireLock(),
-            mesh=default_mesh(),
+            mesh=(make_mesh(mesh_axes) if mesh_axes else default_mesh()),
             checkpoint_dir=self.getOrDefault(self.checkpointDir),
             checkpoint_every=self.getOrDefault(self.checkpointEvery) or 0,
         )

@@ -94,7 +94,8 @@ class Trainer:
                  resume_retries: int = 2,
                  straggler_factor: Optional[float] = None,
                  straggler_callback: Optional[Callable] = None,
-                 metrics=None):
+                 metrics=None,
+                 param_sharding: Union[str, None, dict] = "auto"):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -145,12 +146,60 @@ class Trainer:
         self.resume_retries = resume_retries
         self.straggler_factor = straggler_factor
         self.straggler_callback = straggler_callback
+        # Sharded-parameter training (tp/fsdp): "auto" derives PartitionSpecs
+        # from the model when the mesh has tensor axes beyond 'dp'
+        # (megatron rules via model.param_pspecs(), ZeRO via fsdp_pspecs);
+        # an explicit pspec pytree is used as-is; None keeps params
+        # replicated (pure dp). See parallel/tp.py:derive_param_pspecs.
+        self.param_sharding = param_sharding
         if metrics is None:
             from .utils.metrics import default_metrics
             metrics = default_metrics
         self.metrics = metrics
 
     # -- batching plan ------------------------------------------------------
+
+    def _resolve_pspecs(self):
+        """PartitionSpec pytree for sharded-parameter training, or None.
+        Only meaningful on a multi-device mesh with tensor axes beyond 'dp'
+        (pure-dp meshes replicate params regardless)."""
+        if self.mesh is None:
+            return None
+        bad = [a_ for a_ in self.mesh.axis_names if a_ in ("sp", "pp")]
+        if bad:
+            raise ValueError(
+                f"Trainer fits train the dp/tp/fsdp/ep strategies; mesh "
+                f"axes {bad} need the dedicated step builders "
+                f"(parallel.sp.make_sp_train_step / "
+                f"parallel.pp.make_pp_train_step)")
+        if self.param_sharding is None:
+            return None
+        if not isinstance(self.param_sharding, str):
+            return self.param_sharding  # explicit pspec pytree
+        if self.param_sharding != "auto":
+            raise ValueError(
+                f"param_sharding must be 'auto', None, or a PartitionSpec "
+                f"pytree; got {self.param_sharding!r}")
+        if all(a == "dp" for a in self.mesh.axis_names):
+            return None
+        from .parallel.tp import derive_param_pspecs
+        pspecs = derive_param_pspecs(self.model, self.mesh)
+        if pspecs is None and any(a_ in self.mesh.axis_names
+                                  for a_ in ("tp", "ep")):
+            # refusing beats silently replicating params and letting the
+            # tensor ranks compute redundant identical work
+            raise ValueError(
+                f"mesh axes {[a_ for a_ in self.mesh.axis_names if a_ != 'dp']} "
+                f"request tensor-sharded params but "
+                f"{type(self.model).__name__} publishes no param_pspecs() "
+                f"(megatron rules exist for the registry transformer/resnet/"
+                f"moe families); use an 'fsdp' axis instead — ZeRO specs "
+                f"derive from param_specs() for any model")
+        return pspecs
+
+    def _place_params(self, params, pspecs):
+        from .parallel.tp import shard_params
+        return shard_params(params, self.mesh, pspecs)
 
     def _dp_size(self) -> int:
         from .parallel.mesh import mesh_axis_size
@@ -234,6 +283,11 @@ class Trainer:
             params = jax.tree.map(lambda a: jnp.array(a), init_params)
         else:
             params = self.model.init(init_rng)
+        pspecs = self._resolve_pspecs()
+        if pspecs is not None:
+            # tp/fsdp: place params per their PartitionSpecs BEFORE the
+            # optimizer init so mu/nu/etc inherit the same placement
+            params = self._place_params(params, pspecs)
         opt_state = self.optimizer.init(params)
 
         ckpt_mgr = None
@@ -252,6 +306,11 @@ class Trainer:
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                if pspecs is not None:
+                    # restored arrays are host-loaded; re-place params (the
+                    # opt state re-places lazily via inferred shardings on
+                    # the first compiled step after resume)
+                    params = self._place_params(params, pspecs)
                 start_epoch = int(state["epoch"])
                 rng = jnp.asarray(state["rng"])
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
@@ -276,13 +335,15 @@ class Trainer:
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
-                    n if mode == "stochastic" else None, k)
+                    n if mode == "stochastic" else None, k,
+                    pspecs is not None)
             if fkey not in self._epoch_cache:
                 loss_fn = make_loss_fn(self.model, self.input_name,
                                        self.label_name)
                 self._epoch_cache[fkey] = make_multi_epoch_fn(
                     loss_fn, self.optimizer, batch, num_batches, mode,
-                    self.shuffle_per_iter, k, self.mesh, n_real=n)
+                    self.shuffle_per_iter, k, self.mesh, n_real=n,
+                    infer_params=pspecs is not None)
             erngs = []
             for _ in range(k):
                 rng, erng = jax.random.split(rng)
@@ -298,12 +359,13 @@ class Trainer:
                                per_epoch * k / max(wall, 1e-9), wall)
 
         cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
-                     n if mode == "stochastic" else None)
+                     n if mode == "stochastic" else None, pspecs is not None)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
             self._epoch_cache[cache_key] = make_epoch_fn(
                 loss_fn, self.optimizer, batch, num_batches, mode,
-                self.shuffle_per_iter, self.mesh, n_real=n)
+                self.shuffle_per_iter, self.mesh, n_real=n,
+                infer_params=pspecs is not None)
         epoch_fn = self._epoch_cache[cache_key]
 
         while True:
@@ -432,9 +494,15 @@ class Trainer:
             params = jax.tree.map(lambda a: jnp.array(a), init_params)
         else:
             params = self.model.init(init_rng)
+        pspecs = self._resolve_pspecs()
+        if pspecs is not None:
+            # streaming honors tp/fsdp sharding exactly like fit(): place
+            # params first so the optimizer state inherits the placement
+            params = self._place_params(params, pspecs)
         opt_state = self.optimizer.init(params)
         loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
-        step = make_train_step(loss_fn, self.optimizer, self.mesh)
+        step = make_train_step(loss_fn, self.optimizer, self.mesh,
+                               infer_params=pspecs is not None)
 
         ckpt_mgr = None
         start_step = 0
@@ -453,6 +521,8 @@ class Trainer:
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                if pspecs is not None:
+                    params = self._place_params(params, pspecs)
                 start_step = int(state["epoch"])
                 rng = jnp.asarray(state["rng"])
                 logger.info("fit_stream resumed weights from step %d",

@@ -435,3 +435,33 @@ def test_old_persisted_model_without_new_params_still_transforms(spark, gaussian
         est._defaultParamMap.pop(p, None)
         est._paramMap.pop(p, None)
     est.fit(gaussian_df)  # no KeyError
+
+
+def test_mesh_shape_fsdp_matches_default(spark, gaussian_df):
+    """meshShape opens tp/fsdp from the Param surface: a 'dp=2,fsdp=4' fit
+    (ZeRO param sharding over the virtual 8-device mesh) must produce the
+    SAME weights as the default dp fit — sharding is placement, not math."""
+    mg = build_graph(create_model)
+    m_def = base_estimator(mg, iters=12).fit(gaussian_df)
+    m_fs = base_estimator(mg, iters=12, meshShape="dp=2,fsdp=4").fit(gaussian_df)
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    w_def = convert_json_to_weights(m_def.getOrDefault(m_def.modelWeights))
+    w_fs = convert_json_to_weights(m_fs.getOrDefault(m_fs.modelWeights))
+    for a, b in zip(w_def, w_fs):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_mesh_shape_validation(spark, gaussian_df):
+    mg = build_graph(create_model)
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        base_estimator(mg, meshShape="dp=2,bogus=4").fit(gaussian_df)
+    with pytest.raises(ValueError, match="not estimator strategies"):
+        base_estimator(mg, meshShape="dp=2,sp=4").fit(gaussian_df)
+    with pytest.raises(ValueError, match="param_pspecs"):
+        # tp on an nn-DSL graph: no megatron rules -> must refuse, not
+        # silently replicate (redundant work on every tp rank)
+        base_estimator(mg, meshShape="dp=2,tp=4").fit(gaussian_df)
+    with pytest.raises(ValueError, match="devices"):
+        base_estimator(mg, meshShape="dp=3").fit(gaussian_df)
+    with pytest.raises(ValueError, match="cannot be auto-derived"):
+        base_estimator(mg, meshShape="dp=2,tp=2,fsdp=2").fit(gaussian_df)
