@@ -1,0 +1,12 @@
+"""Import-compatibility alias: ``from sparkflow_tpu.HogwildSparkModel import
+HogwildSparkModel`` works exactly like the reference's
+``from sparkflow.HogwildSparkModel import HogwildSparkModel``
+(``sparkflow/HogwildSparkModel.py:103``).
+
+The real implementation lives in :mod:`sparkflow_tpu.hogwild`: the same
+constructor surface and ``.train(rdd)`` entry point, backed by the synchronous
+mesh trainer (no Flask parameter server exists; ``stop_server`` is a no-op)."""
+
+from .hogwild import HogwildSparkModel
+
+__all__ = ["HogwildSparkModel"]

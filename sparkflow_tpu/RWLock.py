@@ -1,0 +1,11 @@
+"""Import-compatibility alias: ``from sparkflow_tpu.RWLock import RWLock``
+works exactly like the reference's ``from sparkflow.RWLock import RWLock``
+(``sparkflow/RWLock.py:10``).
+
+The real implementation lives in :mod:`sparkflow_tpu.utils.rwlock` — same
+semantics (concurrent readers, write priority, single ``release``) plus
+context managers."""
+
+from .utils.rwlock import RWLock
+
+__all__ = ["RWLock"]

@@ -1,0 +1,14 @@
+"""Import-compatibility alias: ``from sparkflow_tpu.tensorflow_model_loader
+import load_tensorflow_model`` works exactly like the reference's
+``from sparkflow.tensorflow_model_loader import load_tensorflow_model``
+(``sparkflow/tensorflow_model_loader.py:8,35``).
+
+The real implementation lives in :mod:`sparkflow_tpu.model_loader` (TF1 Saver
+checkpoints are read straight off their shards; graphs rebuild in the DSL)."""
+
+from .model_loader import (attach_pretrained_model_to_pipeline,
+                           attach_tensorflow_model_to_pipeline,
+                           extract_tensorflow_weights, load_tensorflow_model)
+
+__all__ = ["load_tensorflow_model", "attach_tensorflow_model_to_pipeline",
+           "attach_pretrained_model_to_pipeline", "extract_tensorflow_weights"]

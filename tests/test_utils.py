@@ -114,3 +114,26 @@ def test_tracing_annotate_runs():
     with annotate("test-region"):
         v = jax.jit(lambda x: x * 2)(jnp.ones(4))
     assert float(v.sum()) == 8.0
+
+
+def test_reference_import_paths():
+    """Every module path a reference user imports exists here with the same
+    public symbols (swap `sparkflow` -> `sparkflow_tpu` and code ports):
+    tensorflow_async, tensorflow_model_loader, HogwildSparkModel, RWLock,
+    ml_util, graph_utils, pipeline_util (reference tree listing)."""
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL, SparkAsyncDLModel
+    from sparkflow_tpu.tensorflow_model_loader import (
+        attach_tensorflow_model_to_pipeline, load_tensorflow_model)
+    from sparkflow_tpu.HogwildSparkModel import HogwildSparkModel
+    from sparkflow_tpu.RWLock import RWLock
+    from sparkflow_tpu.ml_util import (convert_json_to_weights,
+                                       convert_weights_to_json, predict_func)
+    from sparkflow_tpu.graph_utils import build_adam_config, build_graph
+    from sparkflow_tpu.pipeline_util import (PysparkPipelineWrapper,
+                                             PysparkReaderWriter)
+    for sym in (SparkAsyncDL, SparkAsyncDLModel, load_tensorflow_model,
+                attach_tensorflow_model_to_pipeline, HogwildSparkModel,
+                RWLock, predict_func, convert_weights_to_json,
+                convert_json_to_weights, build_graph, build_adam_config,
+                PysparkPipelineWrapper, PysparkReaderWriter):
+        assert callable(sym) or isinstance(sym, type)
