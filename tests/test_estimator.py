@@ -465,3 +465,32 @@ def test_mesh_shape_validation(spark, gaussian_df):
         base_estimator(mg, meshShape="dp=3").fit(gaussian_df)
     with pytest.raises(ValueError, match="cannot be auto-derived"):
         base_estimator(mg, meshShape="dp=2,tp=2,fsdp=2").fit(gaussian_df)
+
+
+def test_mesh_shape_tp_transformer(spark):
+    """tp via meshShape on a registry transformer (has megatron rules):
+    estimator-level tensor parallelism, loss-exact vs the default dp fit."""
+    from sparkflow_tpu.models import build_registry_spec
+
+    spec = build_registry_spec("transformer_classifier", vocab_size=30,
+                               num_classes=2, hidden=32, num_layers=2,
+                               num_heads=4, mlp_dim=64, max_len=8, dropout=0.0)
+    rs = np.random.RandomState(7)
+    rows = [(float(rs.randint(0, 2)),
+             Vectors.dense(rs.randint(0, 30, 8).astype(float)))
+            for _ in range(64)]
+    df = spark.createDataFrame(rows, ["label", "features"])
+
+    def est(**kw):
+        return SparkAsyncDL(inputCol="features", tensorflowGraph=spec,
+                            tfInput="input_ids", tfLabel="y", tfOutput="logits",
+                            labelCol="label", tfOptimizer="adam",
+                            tfLearningRate=.01, iters=4, miniBatchSize=16,
+                            predictionCol="predicted", **kw)
+
+    m_tp = est(meshShape="dp=2,tp=4").fit(df)
+    m_dp = est().fit(df)
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    for a, b in zip(convert_json_to_weights(m_tp.getOrDefault(m_tp.modelWeights)),
+                    convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
+        np.testing.assert_allclose(a, b, atol=5e-4)
