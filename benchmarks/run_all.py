@@ -217,6 +217,59 @@ def bench_tokenizer():
               {"skipped": "no C++ toolchain"})
 
 
+def bench_dataplane():
+    """Native C++ batch-assembly ring vs the python fallback queue — host-side
+    streaming throughput (rows/sec), measurable on any machine. The ring is
+    what feeds the device in `fitMode='stream'`."""
+    import threading
+
+    from sparkflow_tpu.utils import data as D
+
+    n_rows = 20_000 if QUICK else 200_000
+    row_dim, bs = 64, 256
+    rows = np.random.RandomState(0).rand(n_rows, row_dim).astype(np.float32)
+    chunks = [rows[i:i + 1024] for i in range(0, n_rows, 1024)]
+
+    def pump(use_native):
+        real_loader = D.load_library
+        if not use_native:
+            D.load_library = lambda: None
+        try:
+            q = D.BatchQueue(bs, row_dim, 0, capacity=8, shuffle=True)
+        finally:
+            D.load_library = real_loader
+        if use_native and q._lib is None:
+            q.close()
+            return None
+
+        def feed():
+            for c in chunks:
+                q.push(c)
+            q.finish()
+
+        t = threading.Thread(target=feed, daemon=True)
+        t0 = time.perf_counter()
+        t.start()
+        seen = 0
+        for x, y, mask, n_real in q:
+            seen += n_real
+        dt = time.perf_counter() - t0
+        t.join()
+        q.close()
+        assert seen == n_rows, (seen, n_rows)
+        return n_rows / dt
+
+    native = pump(True)
+    python = pump(False)
+    if native:
+        _emit("dataplane_ring_native_vs_python", native / python, "speedup_x",
+              {"native_rows_per_sec": round(native),
+               "python_rows_per_sec": round(python)})
+    else:
+        _emit("dataplane_ring_native_vs_python", 0, "speedup_x",
+              {"skipped": "no C++ toolchain"})
+
+
 def main():
     import os
     import sys as _sys
@@ -237,6 +290,7 @@ def main():
     bench_bert_step(compute_dtype)
     bench_flash_attention()
     bench_tokenizer()
+    bench_dataplane()
 
 
 if __name__ == "__main__":
