@@ -206,6 +206,70 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
     opts = dict(optimizer_options or {})
     opts.pop("use_locking", None)
     lr = _pop(opts, "learning_rate", default=learning_rate if learning_rate is not None else 0.001)
+    schedule = opts.pop("schedule", None)     # upgrade: LR schedules (below)
+    accum = int(opts.pop("grad_accum_steps", 0) or 0)
+
+    base = _build_base_optimizer(optimizer_name, lr, opts)
+    if accum > 1:
+        # gradient accumulation: optax.MultiSteps applies the update every
+        # `accum` mini-steps with the averaged gradient — large effective
+        # batch without the HBM for it; state checkpoints like any pytree
+        base = optax.MultiSteps(base, every_k_schedule=accum)
+    if schedule is not None:
+        # RELATIVE schedule: scales the applied update (== scaling lr for the
+        # optax optimizers; for the closed-form TF1 variants it scales the
+        # final delta). Chained OUTSIDE MultiSteps so the schedule counts
+        # MINI-steps — warmup_steps/decay_steps mean Trainer batches whether
+        # or not accumulation is on (on skipped mini-steps it scales a zero
+        # update, a no-op).
+        base = optax.chain(base, optax.scale_by_schedule(
+            build_schedule(schedule)))
+    return base
+
+
+def build_schedule(cfg) -> optax.Schedule:
+    """JSON-friendly schedule spec -> optax schedule of RELATIVE lr factors
+    (1.0 = the optimizer's configured learning rate).
+
+    ``{"type": "warmup_cosine", "warmup_steps": W, "decay_steps": D,
+       "end_factor": a}``  — linear 0->1 over W, cosine 1->a over D
+    ``{"type": "cosine", "decay_steps": D, "end_factor": a}``
+    ``{"type": "linear", "decay_steps": D, "end_factor": a}``
+    ``{"type": "exponential", "decay_steps": D, "decay_rate": r}``
+    ``{"type": "warmup", "warmup_steps": W}``
+    """
+    if callable(cfg):
+        return cfg
+    if isinstance(cfg, str):
+        cfg = {"type": cfg}  # shorthand: "cosine" == {"type": "cosine"}
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"schedule spec must be a dict like {{'type': 'warmup_cosine', "
+            f"...}}, a type name string, or a callable; got {cfg!r}")
+    kind = cfg.get("type", "warmup_cosine")
+    warm = int(cfg.get("warmup_steps", 0))
+    decay = int(cfg.get("decay_steps", 0))
+    end = float(cfg.get("end_factor", 0.0))
+    if kind == "warmup":
+        return optax.linear_schedule(0.0, 1.0, max(1, warm))
+    if kind == "linear":
+        return optax.linear_schedule(1.0, end, max(1, decay))
+    if kind == "exponential":
+        return optax.exponential_decay(1.0, max(1, decay),
+                                       float(cfg.get("decay_rate", 0.96)))
+    if kind == "cosine":
+        return optax.cosine_decay_schedule(1.0, max(1, decay), alpha=end)
+    if kind == "warmup_cosine":
+        if not warm:
+            return optax.cosine_decay_schedule(1.0, max(1, decay), alpha=end)
+        return optax.warmup_cosine_decay_schedule(
+            0.0, 1.0, warm, max(warm + 1, warm + decay), end_value=end)
+    raise ValueError(f"unknown schedule type {kind!r}; known: warmup, "
+                     f"linear, exponential, cosine, warmup_cosine")
+
+
+def _build_base_optimizer(optimizer_name: str, lr, opts
+                          ) -> optax.GradientTransformation:
 
     if optimizer_name == "adam":
         return optax.adam(lr, b1=_pop(opts, "beta1", "b1", default=0.9),

@@ -87,3 +87,101 @@ def test_momentum_default_when_no_options():
     upd2, state = opt.update(g, state, params)
     # second update includes momentum: |upd2| = 1 + 0.9
     np.testing.assert_allclose(np.asarray(upd2["w"]["v"]), [-1.9], rtol=1e-6)
+
+
+def test_lr_schedule_relative_factors():
+    """`schedule` in optimizer_options composes with ANY registry optimizer:
+    relative factors multiply the configured lr (warmup 0->1, cosine 1->end)."""
+    import jax.numpy as jnp
+    import optax
+
+    from sparkflow_tpu.optimizers import build_optimizer, build_schedule
+
+    s = build_schedule({"type": "warmup_cosine", "warmup_steps": 4,
+                        "decay_steps": 12, "end_factor": 0.1})
+    assert float(s(0)) == 0.0
+    assert abs(float(s(2)) - 0.5) < 1e-6          # mid-warmup
+    assert abs(float(s(4)) - 1.0) < 1e-6          # peak
+    assert float(s(100)) <= 0.1 + 1e-6            # decayed to end_factor
+
+    opt = build_optimizer("gradient_descent", 1.0,
+                          {"schedule": {"type": "linear", "decay_steps": 2,
+                                        "end_factor": 0.0}})
+    p = {"w": jnp.ones(2)}
+    st = opt.init(p)
+    g = {"w": jnp.ones(2)}
+    u0, st = opt.update(g, st, p)                 # factor 1.0
+    u1, st = opt.update(g, st, p)                 # factor 0.5
+    u2, st = opt.update(g, st, p)                 # factor 0.0
+    assert abs(float(u0["w"][0]) + 1.0) < 1e-6
+    assert abs(float(u1["w"][0]) + 0.5) < 1e-6
+    assert abs(float(u2["w"][0])) < 1e-6
+
+    with pytest.raises(ValueError, match="unknown schedule type"):
+        build_schedule({"type": "bogus"})
+
+
+def test_grad_accumulation_matches_bigger_batch():
+    """grad_accum_steps=2 at batch B equals one step at batch 2B for sgd
+    (masked-mean loss; sweep mode, shuffle off) — through the full Trainer."""
+    import sparkflow_tpu.nn as nn
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.trainer import Trainer
+
+    def mlp():
+        x = nn.placeholder([None, 6], name="x")
+        y = nn.placeholder([None, 2], name="y")
+        out = nn.dense(x, 2, name="out")
+        nn.softmax_cross_entropy(y, out)
+
+    rs = np.random.RandomState(0)
+    xs = rs.rand(32, 6).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+
+    def fit(batch, accum):
+        opts = {"learning_rate": 0.5}
+        if accum:
+            opts["grad_accum_steps"] = accum
+        tr = Trainer(build_graph(mlp), "x:0", "y:0",
+                     optimizer="gradient_descent", optimizer_options=opts,
+                     iters=2, mini_batch_size=batch, shuffle_per_iter=False,
+                     seed=0)
+        return tr.fit(xs, ys).params
+
+    pa = fit(8, 2)
+    pb = fit(16, None)
+    la, lb = jax.tree.leaves(pa), jax.tree.leaves(pb)
+    assert len(la) == len(lb)
+    for va, vb in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vb), atol=1e-5)
+
+
+def test_schedule_counts_ministeps_under_accumulation():
+    """warmup_steps/decay_steps mean Trainer mini-batches even with
+    grad_accum_steps on: the schedule chains OUTSIDE MultiSteps (a k-stretch
+    of the schedule would otherwise silently happen)."""
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.optimizers import build_optimizer
+
+    opt = build_optimizer("gradient_descent", 1.0,
+                          {"schedule": {"type": "linear", "decay_steps": 4,
+                                        "end_factor": 0.0},
+                           "grad_accum_steps": 2})
+    p = {"w": jnp.zeros(1)}
+    st = opt.init(p)
+    g = {"w": jnp.ones(1)}
+    u0, st = opt.update(g, st, p)           # mini-step 0: accumulate, zero out
+    u1, st = opt.update(g, st, p)           # mini-step 1: apply, factor s(1)
+    assert float(u0["w"][0]) == 0.0
+    # s(1) = 1 - 1/4 = 0.75 on the MINI-step clock (k-stretched would be 7/8)
+    assert abs(float(u1["w"][0]) + 0.75) < 1e-6
+
+
+def test_schedule_string_shorthand_and_bad_spec():
+    from sparkflow_tpu.optimizers import build_schedule
+
+    s = build_schedule("cosine")
+    assert abs(float(s(0)) - 1.0) < 1e-6
+    with pytest.raises(ValueError, match="schedule spec"):
+        build_schedule(42)
