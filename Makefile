@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark bench baseline examples native clean
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench baseline examples native clean
 
 test:
 	python -m pytest tests/ -q
@@ -30,6 +30,9 @@ examples:
 	cd examples && PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python simple_dnn.py && \
 	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python cnn_example.py && \
 	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python autoencoder_example.py
+
+docker-test-pyspark:
+	docker compose run --build test-pyspark
 
 native:
 	python -c "from sparkflow_tpu.native.build import load_library; \
