@@ -32,7 +32,7 @@ examples:
 	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python autoencoder_example.py
 
 docker-test-pyspark:
-	docker compose run --build test-pyspark
+	docker compose run --rm --build test-pyspark
 
 native:
 	python -c "from sparkflow_tpu.native.build import load_library; \

@@ -494,3 +494,13 @@ def test_mesh_shape_tp_transformer(spark):
     for a, b in zip(convert_json_to_weights(m_tp.getOrDefault(m_tp.modelWeights)),
                     convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
         np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_fit_mode_stream_with_fsdp_mesh(spark, gaussian_df):
+    """fitMode='stream' honors meshShape ZeRO sharding (stream sharding
+    support landed with the meshShape Param): trains through toLocalIterator
+    with params placed over fsdp, and still learns."""
+    mg = build_graph(create_model)
+    model = base_estimator(mg, iters=20, fitMode="stream", miniBatchSize=64,
+                           meshShape="dp=2,fsdp=4").fit(gaussian_df)
+    assert calculate_errors(model.transform(gaussian_df)) < 400

@@ -7,6 +7,7 @@ reference's ``build_graph`` output format (``sparkflow/graph_utils.py:6-15``)
 """
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -390,7 +391,10 @@ def test_differential_fuzz_vs_tf_session():
 
     acts = [None, tf.nn.relu, tf.nn.sigmoid, tf.nn.tanh, tf.nn.softplus]
     rs = np.random.RandomState(42)
-    for trial in range(5):
+    # SPARKFLOW_FUZZ_TRIALS scales the sweep (default keeps the suite fast;
+    # long sweeps run out-of-band, e.g. SPARKFLOW_FUZZ_TRIALS=40)
+    trials = int(os.environ.get("SPARKFLOW_FUZZ_TRIALS", "5"))
+    for trial in range(trials):
         depth = rs.randint(1, 4)
         widths = [int(w) for w in rs.randint(2, 9, depth)]
         in_dim = int(rs.randint(2, 6))
