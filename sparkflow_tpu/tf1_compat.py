@@ -183,14 +183,18 @@ class TF1GraphModel:
             if not scopes_seen or scopes_seen[-1] != scope:
                 scopes_seen.append(scope)
 
-        # assign node per variable (for init-value subgraph evaluation)
+        # assign node per variable (for init-value subgraph evaluation).
+        # Recorded for EVERY variable node, not just trainables: non-trainable
+        # variables (batch-norm moving stats) are read via their initializer.
         self._var_init = {}
         for n in self._nodes.values():
             if n["op"] in ("Assign", "AssignVariableOp"):
                 ins = n.get("input", [])
                 if len(ins) >= 2:
                     target = ins[0].split(":")[0].lstrip("^")
-                    if target in self._var_shapes and target not in self._var_init:
+                    tnode = self._nodes.get(target)
+                    if (tnode is not None and tnode["op"] in _VAR_OPS
+                            and target not in self._var_init):
                         self._var_init[target] = ins[1]
 
     # -- GraphModel duck type -------------------------------------------------
@@ -369,7 +373,27 @@ class _Evaluator:
         if op == "Const":
             return _parse_const(node)
         if op in _VAR_OPS:
-            return self.m._param_value(self.params, node["name"])
+            name = node["name"]
+            if name in self.m._var_shapes:
+                return self.m._param_value(self.params, name)
+            # non-trainable variable (e.g. batch-norm moving_mean/variance):
+            # not in the trainable collection, so it has no params slot —
+            # evaluate its initializer subgraph instead. The wire format only
+            # carries trainables, so learned moving stats cannot survive a
+            # round-trip: warn, because inference through such a node uses
+            # FRESH-INIT values (0/1), not whatever the source graph learned
+            import warnings
+            warnings.warn(
+                f"reading non-trainable variable {name!r} via its initializer "
+                f"subgraph (the reference wire format carries trainable "
+                f"variables only); if this model relies on learned "
+                f"non-trainable state (e.g. batch-norm moving statistics), "
+                f"those values are fresh-initialized here", stacklevel=2)
+            init_node = self.m._var_init.get(name)
+            if init_node is not None:
+                return self.value(init_node)
+            shape = _attr_shape(node)
+            return jnp.zeros(shape, _attr_type(node))
         if op in ("ReadVariableOp", "Identity", "StopGradient", "Snapshot",
                   "PreventGradient", "CheckNumerics", "EnsureShape"):
             return self._in(node, 0)
@@ -396,6 +420,10 @@ class _Evaluator:
             "Less": (np.less, jnp.less), "LessEqual": (np.less_equal, jnp.less_equal),
             "LogicalAnd": (np.logical_and, jnp.logical_and),
             "LogicalOr": (np.logical_or, jnp.logical_or),
+            "FloorMod": (np.mod, jnp.mod),
+            "Mod": (np.fmod, jnp.fmod),
+            "TruncateMod": (np.fmod, jnp.fmod),
+            "Atan2": (np.arctan2, jnp.arctan2),
         }
         if op in binary:
             a, b = self._in(node, 0), self._in(node, 1)
@@ -425,6 +453,11 @@ class _Evaluator:
             "ZerosLike": (np.zeros_like, jnp.zeros_like),
             "OnesLike": (np.ones_like, jnp.ones_like),
             "Reciprocal": (lambda x: 1 / x, lambda x: 1 / x),
+            "Inv": (lambda x: 1 / x, lambda x: 1 / x),
+            "Sin": (np.sin, jnp.sin), "Cos": (np.cos, jnp.cos),
+            "Tan": (np.tan, jnp.tan), "Atan": (np.arctan, jnp.arctan),
+            "Expm1": (np.expm1, jnp.expm1),
+            "Softsign": (None, jax.nn.soft_sign),
         }
         if op in unary:
             x = self._in(node, 0)
@@ -496,6 +529,181 @@ class _Evaluator:
             ones = jnp.ones_like(x)
             c = jax.lax.reduce_window(ones, 0.0, jax.lax.add, ks, st, padding)
             return s / c
+
+        if op == "LeakyRelu":
+            alpha = float(attr.get("alpha", {}).get("f", 0.2))
+            return jax.nn.leaky_relu(jnp.asarray(self._in(node, 0)),
+                                     negative_slope=alpha)
+        if op == "AddN":
+            vals = self._ins(node)
+            if _is_static(*vals):
+                return np.asarray(sum(np.asarray(v) for v in vals))
+            out = jnp.asarray(vals[0])
+            for v in vals[1:]:
+                out = out + jnp.asarray(v)
+            return out
+        if op == "SparseSoftmaxCrossEntropyWithLogits":
+            logits = jnp.asarray(self._in(node, 0))
+            labels = jnp.asarray(self._in(node, 1)).astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            loss = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            grad = (jax.nn.softmax(logits, axis=-1)
+                    - jax.nn.one_hot(labels, logits.shape[-1],
+                                     dtype=logits.dtype))
+            return (loss, grad)
+        if op == "OneHot":
+            indices = self._in(node, 0)
+            depth = int(np.asarray(self._in(node, 1)))
+            on_v = self._in(node, 2)
+            off_v = self._in(node, 3)
+            axis = int(attr.get("axis", {}).get("i", -1))
+            ind = jnp.asarray(indices).astype(jnp.int32)
+            oh = jax.nn.one_hot(ind, depth, axis=axis)
+            on_v, off_v = jnp.asarray(on_v), jnp.asarray(off_v)
+            return (oh * (on_v - off_v) + off_v).astype(on_v.dtype)
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            x = jnp.asarray(self._in(node, 0))
+            scale = jnp.asarray(self._in(node, 1))
+            offset = jnp.asarray(self._in(node, 2))
+            eps = float(attr.get("epsilon", {}).get("f", 1e-3))
+            training = bool(attr.get("is_training", {}).get("b", True))
+            fmt = attr.get("data_format", {}).get("s")
+            if fmt and _b64str(fmt) not in ("NHWC", ""):
+                raise NotImplementedError(
+                    f"{op} with data_format={_b64str(fmt)!r}: NHWC only")
+            if training:
+                axes = tuple(range(x.ndim - 1))
+                mean = jnp.mean(x, axis=axes)
+                var = jnp.var(x, axis=axes)
+            else:
+                mean = jnp.asarray(self._in(node, 3))
+                var = jnp.asarray(self._in(node, 4))
+            inv = jax.lax.rsqrt(var + eps)
+            y = (x - mean) * inv * scale + offset
+            # outputs: y, batch_mean, batch_var(, reserved...) — reserved
+            # slots mirror the stats, enough for any consumer on the value path
+            return (y, mean, var, mean, var, var)
+        if op in ("BatchMatMul", "BatchMatMulV2"):
+            a = self._compute_cast(self._in(node, 0))
+            b = self._compute_cast(self._in(node, 1))
+            if attr.get("adj_x", {}).get("b"):
+                a = jnp.swapaxes(a, -1, -2)
+            if attr.get("adj_y", {}).get("b"):
+                b = jnp.swapaxes(b, -1, -2)
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        if op == "DepthwiseConv2dNative":
+            x = self._compute_cast(self._in(node, 0))
+            k = self._compute_cast(self._in(node, 1))  # [H, W, C, M]
+            strides = [int(s) for s in attr["strides"]["list"]["i"]]
+            padding = _b64str(attr["padding"]["s"])
+            dil = [int(d) for d in attr.get("dilations", {})
+                   .get("list", {}).get("i", [1, 1, 1, 1])]
+            h, w, c, m = k.shape
+            # grouped conv: one group per input channel, kernel [H, W, 1, C*M]
+            k = jnp.reshape(k, (h, w, 1, c * m))
+            return jax.lax.conv_general_dilated(
+                x, k, window_strides=strides[1:3], padding=padding,
+                rhs_dilation=dil[1:3],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=c,
+                preferred_element_type=jnp.float32)
+        if op == "LRN":
+            x = jnp.asarray(self._in(node, 0))
+            radius = int(attr.get("depth_radius", {}).get("i", 5))
+            bias = float(attr.get("bias", {}).get("f", 1.0))
+            alpha = float(attr.get("alpha", {}).get("f", 1.0))
+            beta = float(attr.get("beta", {}).get("f", 0.5))
+            sq = jnp.square(x)
+            win = 2 * radius + 1
+            sq_sum = jax.lax.reduce_window(
+                sq, 0.0, jax.lax.add, (1, 1, 1, win), (1, 1, 1, 1), "SAME")
+            return x / jnp.power(bias + alpha * sq_sum, beta)
+        if op == "Cumsum":
+            x = jnp.asarray(self._in(node, 0))
+            axis = int(np.asarray(self._in(node, 1)))
+            exclusive = bool(attr.get("exclusive", {}).get("b", False))
+            reverse = bool(attr.get("reverse", {}).get("b", False))
+            if reverse:
+                x = jnp.flip(x, axis)
+            out = jnp.cumsum(x, axis=axis)
+            if exclusive:
+                out = out - x
+            if reverse:
+                out = jnp.flip(out, axis)
+            return out
+        if op == "TopKV2":
+            x = jnp.asarray(self._in(node, 0))
+            k = int(np.asarray(self._in(node, 1)))
+            vals, idx = jax.lax.top_k(x, k)
+            return (vals, idx.astype(jnp.int32))
+        if op in ("Split", "SplitV", "Unpack"):
+            if op == "Unpack":
+                x = jnp.asarray(self._in(node, 0))
+                axis = int(attr.get("axis", {}).get("i", 0))
+                n = int(attr.get("num", {}).get("i", x.shape[axis]))
+                parts = jnp.split(x, n, axis=axis)
+                return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+            if op == "Split":  # inputs: (axis, value)
+                axis = int(np.asarray(self._in(node, 0)))
+                x = jnp.asarray(self._in(node, 1))
+                n = int(attr.get("num_split", {}).get("i", 1))
+                return tuple(jnp.split(x, n, axis=axis))
+            # SplitV: (value, size_splits, axis)
+            x = jnp.asarray(self._in(node, 0))
+            sizes = [int(s) for s in np.asarray(self._in(node, 1)).reshape(-1)]
+            axis = int(np.asarray(self._in(node, 2)))
+            if sizes.count(-1) > 1:
+                raise NotImplementedError(
+                    f"SplitV (node {node['name']!r}): more than one inferred "
+                    f"(-1) entry in size_splits {sizes}")
+            if -1 in sizes:  # one entry may be inferred from the dim size
+                rest = sum(s for s in sizes if s != -1)
+                sizes[sizes.index(-1)] = int(x.shape[axis]) - rest
+            bounds = np.cumsum(sizes)[:-1].tolist()
+            return tuple(jnp.split(x, bounds, axis=axis))
+        if op in ("SpaceToBatchND", "BatchToSpaceND"):
+            # the lowering TF emits for dilated (atrous) convolutions
+            x = jnp.asarray(self._in(node, 0))
+            block = [int(b) for b in np.asarray(self._in(node, 1)).reshape(-1)]
+            pc = np.asarray(self._in(node, 2)).reshape(-1, 2)
+            m = len(block)
+            rest = list(x.shape[1 + m:])
+            if op == "SpaceToBatchND":
+                pads = ([(0, 0)] + [(int(a), int(b)) for a, b in pc]
+                        + [(0, 0)] * len(rest))
+                x = jnp.pad(x, pads)
+                batch, spatial = x.shape[0], x.shape[1:1 + m]
+                shape = [batch]
+                for d, b in zip(spatial, block):
+                    shape += [d // b, b]
+                x = jnp.reshape(x, shape + rest)
+                perm = ([2 * i + 2 for i in range(m)] + [0]
+                        + [2 * i + 1 for i in range(m)]
+                        + [2 * m + 1 + i for i in range(len(rest))])
+                x = jnp.transpose(x, perm)
+                return jnp.reshape(
+                    x, [batch * int(np.prod(block))]
+                    + [spatial[i] // block[i] for i in range(m)] + rest)
+            batch, spatial = x.shape[0], x.shape[1:1 + m]
+            prod_b = int(np.prod(block))
+            x = jnp.reshape(x, list(block) + [batch // prod_b]
+                            + list(spatial) + rest)
+            perm = [m]
+            for i in range(m):
+                perm += [m + 1 + i, i]
+            perm += [2 * m + 1 + i for i in range(len(rest))]
+            x = jnp.transpose(x, perm)
+            x = jnp.reshape(x, [batch // prod_b]
+                            + [spatial[i] * block[i] for i in range(m)] + rest)
+            idx = [slice(None)]
+            for i in range(m):
+                c0, c1 = int(pc[i][0]), int(pc[i][1])
+                idx.append(slice(c0, x.shape[1 + i] - c1 if c1 else None))
+            return x[tuple(idx + [slice(None)] * len(rest))]
+        if op in ("Print", "PrintV2", "Assert"):
+            # debug/validation side-effects: pass through / no-op on the
+            # value path (Assert appears only as a control dependency)
+            return self._in(node, 0) if node.get("input") else None
 
         # --- reductions / indexing ---
         reductions = {"Sum": jnp.sum, "Mean": jnp.mean, "Max": jnp.max,
@@ -612,6 +820,10 @@ class _Evaluator:
             ind = jnp.asarray(self._in(node, 1)).astype(jnp.int32)
             axis = int(np.asarray(self._in(node, 2)))
             return jnp.take(x, ind, axis=axis)
+        if op == "ResourceGather":  # embedding_lookup on a resource variable
+            x = jnp.asarray(self._in(node, 0))
+            ind = jnp.asarray(self._in(node, 1)).astype(jnp.int32)
+            return jnp.take(x, ind, axis=0)
         if op == "BroadcastTo":
             x = jnp.asarray(self._in(node, 0))
             shape = [int(s) for s in np.asarray(self._in(node, 1)).reshape(-1)]

@@ -443,3 +443,202 @@ def test_differential_fuzz_vs_tf_session():
         lv = np.asarray(m.loss_vector(params, {"x": X, "y": Y}, train=False))
         np.testing.assert_allclose(lv.mean(), float(tf_loss), rtol=1e-4,
                                    err_msg=f"trial {trial} loss ({loss_kind})")
+
+
+# ---------------------------------------------------------------------------
+# round-2 widened op coverage — every case is differential vs a live session
+# ---------------------------------------------------------------------------
+
+def _session_fwd(build, out_names, feeds):
+    """Export a metagraph, run outputs in a real tf.Session (after global
+    init), and return (metagraph_json, trainable_weights, {name: np_out})."""
+    from google.protobuf import json_format
+    g = tf1.Graph()
+    with g.as_default():
+        build()
+        mg = json_format.MessageToJson(tf1.train.export_meta_graph())
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            w = sess.run(tf1.trainable_variables())
+            outs = sess.run(list(out_names), feeds)
+    return mg, w, dict(zip(out_names, outs))
+
+
+def _compat_fwd(mg, w, out_names, feeds):
+    from sparkflow_tpu.graphdef import list_to_params
+    m = model_from_json(mg)
+    params = list_to_params(m, w)
+    res = m.apply(params, {k.split(":")[0]: v for k, v in feeds.items()},
+                  list(out_names))
+    return {k: np.asarray(v) for k, v in res.items()}
+
+
+def test_extended_elementwise_ops_match_session():
+    """sin/cos/leaky_relu/add_n/floormod/cumsum — common TF1 math plumbing."""
+    rs = np.random.RandomState(3)
+    X = rs.randn(6, 5).astype(np.float32)
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 5], name="x")
+        a = tf.sin(x) + tf.cos(x)
+        b = tf.nn.leaky_relu(x, alpha=0.1)
+        c = tf1.add_n([a, b, tf.square(x)])
+        d = tf.cumsum(c, axis=1)
+        tf1.identity(d + tf1.floormod(x, 2.0), name="out")
+
+    mg, w, tf_out = _session_fwd(build, ["out:0"], {"x:0": X})
+    out = _compat_fwd(mg, w, ["out:0"], {"x:0": X})
+    np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-5)
+
+
+def test_sparse_softmax_ce_matches_session():
+    """tf1.losses.sparse_softmax_cross_entropy — integer labels, the most
+    common TF1 classification loss after the dense one."""
+    from sparkflow_tpu.graphdef import list_to_params
+
+    rs = np.random.RandomState(4)
+    X = rs.randn(9, 4).astype(np.float32)
+    lbl = rs.randint(0, 3, 9).astype(np.int32)
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf1.placeholder(tf.int32, [None], name="y")
+        logits = _dense(x, 3, "lg")
+        tf1.losses.sparse_softmax_cross_entropy(y, logits)
+
+    mg, g = _export(build)
+    with tf1.Session(graph=g) as sess:
+        sess.run(tf1.global_variables_initializer())
+        w = sess.run(tf1.trainable_variables())
+        loss_name = g.get_collection(tf1.GraphKeys.LOSSES)[0].name
+        tf_loss = sess.run(loss_name, {"x:0": X, "y:0": lbl})
+
+    m = model_from_json(mg)
+    params = list_to_params(m, w)
+    lv = np.asarray(m.loss_vector(params, {"x": X, "y": lbl}, train=False))
+    np.testing.assert_allclose(lv.mean(), float(tf_loss), rtol=1e-5)
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_fused_batch_norm_matches_session(training):
+    """tf1.layers.batch_normalization (FusedBatchNormV3). training=True uses
+    batch stats on both sides; training=False reads the freshly-initialized
+    moving stats (0/1) — matched here by evaluating the non-trainable
+    variables' initializer subgraphs."""
+    rs = np.random.RandomState(5)
+    X = rs.randn(8, 6).astype(np.float32)
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 6], name="x")
+        h = _dense(x, 10, "d1", tf.nn.relu)
+        with tf1.variable_scope("bn"):
+            gamma = tf1.get_variable("gamma", [10],
+                                     initializer=tf1.ones_initializer())
+            beta = tf1.get_variable("beta", [10],
+                                    initializer=tf1.zeros_initializer())
+            mm = tf1.get_variable("moving_mean", [10], trainable=False,
+                                  initializer=tf1.zeros_initializer())
+            mv = tf1.get_variable("moving_variance", [10], trainable=False,
+                                  initializer=tf1.ones_initializer())
+        n, _, _ = tf1.nn.fused_batch_norm(
+            tf.reshape(h, [-1, 1, 1, 10]), gamma, beta,
+            mean=None if training else mm,
+            variance=None if training else mv,
+            is_training=training)
+        tf1.identity(tf.nn.relu(tf.reshape(n, [-1, 10])), name="out")
+
+    mg, w, tf_out = _session_fwd(build, ["out:0"], {"x:0": X})
+    out = _compat_fwd(mg, w, ["out:0"], {"x:0": X})
+    np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-4)
+
+
+def test_batch_norm_net_trains():
+    """A batch-normalized classifier fits through the Trainer."""
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 2], name="x")
+        y = tf1.placeholder(tf.float32, [None, 1], name="y")
+        h = _dense(x, 16, "d1", tf.nn.relu)
+        with tf1.variable_scope("bn"):
+            gamma = tf1.get_variable("gamma", [16],
+                                     initializer=tf1.ones_initializer())
+            beta = tf1.get_variable("beta", [16],
+                                    initializer=tf1.zeros_initializer())
+        h2, _, _ = tf1.nn.fused_batch_norm(
+            tf.reshape(h, [-1, 1, 1, 16]), gamma, beta, is_training=True)
+        h2 = tf.reshape(h2, [-1, 16])
+        out = tf1.sigmoid(_dense(h2, 1, "d2"), name="out")
+        tf1.losses.log_loss(y, out)
+
+    mg = _export(build)[0]
+
+    rs = np.random.RandomState(0)
+    X = np.concatenate([rs.normal(1.5, 1, (80, 2)),
+                        rs.normal(-1.5, 1, (80, 2))]).astype(np.float32)
+    Y = np.concatenate([np.ones(80), np.zeros(80)]).astype(np.float32)
+    tr = Trainer(mg, "x:0", "y:0", optimizer="adam", learning_rate=0.05,
+                 iters=25, mini_batch_size=64)
+    res = tr.fit(X, Y)
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_one_hot_embedding_matches_session():
+    """tf.one_hot + embedding-style matmul and tf.nn.embedding_lookup
+    (GatherV2) — the TF1 text-model front door."""
+    rs = np.random.RandomState(6)
+    ids = rs.randint(0, 11, (5, 7)).astype(np.int32)
+
+    def build():
+        i = tf1.placeholder(tf.int32, [None, 7], name="ids")
+        table = tf1.get_variable(
+            "emb", [11, 4], initializer=tf1.glorot_uniform_initializer())
+        looked = tf.nn.embedding_lookup(table, i)
+        oh = tf.one_hot(i, 11, on_value=2.0, off_value=-1.0)
+        tf1.identity(tf.reduce_sum(looked, axis=-1) + tf.reduce_mean(oh, -1),
+                     name="out")
+
+    mg, w, tf_out = _session_fwd(build, ["out:0"], {"ids:0": ids})
+    out = _compat_fwd(mg, w, ["out:0"], {"ids:0": ids})
+    np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-5)
+
+
+def test_split_unstack_topk_batchmatmul_match_session():
+    rs = np.random.RandomState(7)
+    X = rs.randn(4, 6, 6).astype(np.float32)
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 6, 6], name="x")
+        a, b = tf.split(x, 2, axis=2)              # Split
+        _, mid, _ = tf.split(x, [2, -1, 2], axis=2)  # SplitV, inferred size
+        bm = (tf.matmul(a, b, transpose_b=True)    # BatchMatMulV2
+              + tf.reduce_sum(mid, axis=2, keepdims=True))
+        rows = tf.unstack(bm, axis=1)              # Unpack
+        top_v, _ = tf.nn.top_k(rows[0], k=2)       # TopKV2
+        tf1.identity(tf.reduce_sum(top_v, -1), name="out")
+
+    mg, w, tf_out = _session_fwd(build, ["out:0"], {"x:0": X})
+    out = _compat_fwd(mg, w, ["out:0"], {"x:0": X})
+    np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-5)
+
+
+def test_depthwise_conv_and_lrn_match_session():
+    rs = np.random.RandomState(8)
+    X = rs.randn(2, 8, 8, 3).astype(np.float32)
+
+    def build():
+        x = tf1.placeholder(tf.float32, [None, 8, 8, 3], name="x")
+        k = tf1.get_variable("dw", [3, 3, 3, 2],
+                             initializer=tf1.glorot_uniform_initializer())
+        c = tf.nn.depthwise_conv2d(x, k, [1, 1, 1, 1], "SAME")
+        # atrous via SpaceToBatchND/BatchToSpaceND (the composite lowering)
+        c2 = tf.nn.depthwise_conv2d(x, k, [1, 1, 1, 1], "SAME",
+                                    dilations=[2, 2])
+        # atrous via the raw op's dilations attr
+        c3 = tf1.nn.depthwise_conv2d_native(x, k, [1, 1, 1, 1], "SAME",
+                                            dilations=[1, 2, 2, 1])
+        n = tf.nn.lrn(c + c2 + c3, depth_radius=2, bias=1.0, alpha=0.5,
+                      beta=0.75)
+        tf1.identity(n, name="out")
+
+    mg, w, tf_out = _session_fwd(build, ["out:0"], {"x:0": X})
+    out = _compat_fwd(mg, w, ["out:0"], {"x:0": X})
+    np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-4)
