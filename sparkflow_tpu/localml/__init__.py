@@ -15,9 +15,12 @@ touches them):
                    ``MLReadable``, ``MLWritable``
 - ``linalg``:      ``Vectors``, ``DenseVector``, ``SparseVector``
 - ``sql``:         ``Row``, ``DataFrame``, ``RDD``, ``LocalSession``, ``functions.rand``
-- ``feature``:     ``VectorAssembler``, ``OneHotEncoder``, ``Normalizer``
+- ``feature``:     ``VectorAssembler``, ``OneHotEncoder``, ``Normalizer``,
+                   ``Tokenizer``, ``StopWordsRemover``, ``StringIndexer``,
+                   ``StandardScaler``, ``MinMaxScaler``, ``Bucketizer``
 - ``pipeline``:    ``Pipeline``, ``PipelineModel``
-- ``evaluation``:  ``MulticlassClassificationEvaluator``
+- ``evaluation``:  ``MulticlassClassificationEvaluator``,
+                   ``BinaryClassificationEvaluator``
 """
 
 from .param import Param, Params, TypeConverters, keyword_only
@@ -25,9 +28,13 @@ from .base import Estimator, Transformer, Model, Identifiable, MLReadable, MLWri
 from .linalg import Vectors, DenseVector, SparseVector
 from .sql import Row, DataFrame, RDD, LocalSession
 from .feature import (VectorAssembler, OneHotEncoder, Normalizer,
-                      WordpieceEncoder)
+                      WordpieceEncoder, Tokenizer, StopWordsRemover,
+                      StringIndexer, StringIndexerModel,
+                      StandardScaler, StandardScalerModel,
+                      MinMaxScaler, MinMaxScalerModel, Bucketizer)
 from .pipeline import Pipeline, PipelineModel
-from .evaluation import MulticlassClassificationEvaluator
+from .evaluation import (MulticlassClassificationEvaluator,
+                         BinaryClassificationEvaluator)
 
 __all__ = [
     "Param", "Params", "TypeConverters", "keyword_only",
@@ -35,6 +42,9 @@ __all__ = [
     "Vectors", "DenseVector", "SparseVector",
     "Row", "DataFrame", "RDD", "LocalSession",
     "VectorAssembler", "WordpieceEncoder", "OneHotEncoder", "Normalizer",
+    "Tokenizer", "StopWordsRemover", "StringIndexer", "StringIndexerModel",
+    "StandardScaler", "StandardScalerModel", "MinMaxScaler",
+    "MinMaxScalerModel", "Bucketizer",
     "Pipeline", "PipelineModel",
-    "MulticlassClassificationEvaluator",
+    "MulticlassClassificationEvaluator", "BinaryClassificationEvaluator",
 ]

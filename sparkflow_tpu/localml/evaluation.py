@@ -42,3 +42,61 @@ class MulticlassClassificationEvaluator(HasLabelCol, HasPredictionCol):
                 weights.append(float((y == c).sum()))
             return float(np.average(f1s, weights=weights)) if weights else 0.0
         raise ValueError(f"unsupported metric {metric!r}")
+
+
+class BinaryClassificationEvaluator(HasLabelCol, HasPredictionCol):
+    """areaUnderROC / areaUnderPR over a score column
+    (pyspark.ml.evaluation.BinaryClassificationEvaluator). The score column
+    (``rawPredictionCol``) may hold floats or vectors — for vectors the last
+    component is the positive-class score, matching how sparkflow models
+    emit probabilities (reference ``ml_util.py:74-81``)."""
+
+    rawPredictionCol = Param(Params._dummy(), "rawPredictionCol",
+                             "score column",
+                             typeConverter=TypeConverters.toString)
+    metricName = Param(Params._dummy(), "metricName", "metric name",
+                       typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, rawPredictionCol="rawPrediction", labelCol="label",
+                 metricName="areaUnderROC"):
+        super().__init__()
+        self._setDefault(rawPredictionCol="rawPrediction", labelCol="label",
+                         metricName="areaUnderROC")
+        self._set(**self._input_kwargs)
+
+    @staticmethod
+    def _score(v) -> float:
+        arr = np.atleast_1d(np.asarray(
+            v.toArray() if hasattr(v, "toArray") else v, dtype=float))
+        return float(arr[-1])
+
+    def evaluate(self, dataset) -> float:
+        label_col = self.getOrDefault(self.labelCol)
+        score_col = self.getOrDefault(self.rawPredictionCol)
+        metric = self.getOrDefault(self.metricName)
+        rows = dataset.collect()
+        y = np.array([float(r[label_col]) for r in rows])
+        s = np.array([self._score(r[score_col]) for r in rows])
+        if len(y) == 0 or len(np.unique(y)) < 2:
+            return 0.0
+        order = np.argsort(-s, kind="stable")
+        y, s = y[order], s[order]
+        tp = np.cumsum(y == 1)
+        fp = np.cumsum(y == 0)
+        # one curve point per DISTINCT score threshold (keep the last
+        # cumulative count in each tie group) — otherwise tied scores make
+        # the metric row-order-dependent; with collapsed ties the trapezoid
+        # gives ties half credit (Mann-Whitney), matching Spark/sklearn
+        last_of_group = np.concatenate([s[1:] != s[:-1], [True]])
+        tp, fp = tp[last_of_group], fp[last_of_group]
+        P, N = tp[-1], fp[-1]
+        tpr = np.concatenate([[0.0], tp / P])
+        fpr = np.concatenate([[0.0], fp / N])
+        if metric == "areaUnderROC":
+            return float(np.trapezoid(tpr, fpr))
+        if metric == "areaUnderPR":
+            prec = np.concatenate([[1.0], tp / np.maximum(tp + fp, 1)])
+            rec = np.concatenate([[0.0], tp / P])
+            return float(np.trapezoid(prec, rec))
+        raise ValueError(f"unsupported metric {metric!r}")

@@ -8,7 +8,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .base import Transformer
+from .base import Estimator, Model, Transformer
 from .linalg import DenseVector, SparseVector, Vectors, vector_to_array
 from .param import Param, Params, TypeConverters, keyword_only, HasInputCol, HasOutputCol
 from .sql import DataFrame, Row
@@ -158,3 +158,305 @@ class WordpieceEncoder(Transformer, HasInputCol, HasOutputCol):
         cols = dataset.columns + [c for c in (out_col, mask_col)
                                   if c not in dataset.columns]
         return DataFrame(out, cols, dataset.num_partitions)
+
+
+# ---------------------------------------------------------------------------
+# round-2 widening: the rest of the pyspark.ml.feature subset a sparkflow user
+# is likely to have in a Pipeline around the deep-learning stage. Semantics
+# follow pyspark 2.4 (the reference's pinned Spark), cited per class.
+# ---------------------------------------------------------------------------
+
+# pyspark.ml.feature.StopWordsRemover.loadDefaultStopWords("english") subset —
+# enough to be useful while staying compact; users can always setStopWords
+_ENGLISH_STOP_WORDS = [
+    "i", "me", "my", "myself", "we", "our", "ours", "ourselves", "you",
+    "your", "yours", "he", "him", "his", "she", "her", "hers", "it", "its",
+    "they", "them", "their", "theirs", "what", "which", "who", "whom",
+    "this", "that", "these", "those", "am", "is", "are", "was", "were",
+    "be", "been", "being", "have", "has", "had", "having", "do", "does",
+    "did", "doing", "a", "an", "the", "and", "but", "if", "or", "because",
+    "as", "until", "while", "of", "at", "by", "for", "with", "about",
+    "against", "between", "into", "through", "during", "before", "after",
+    "above", "below", "to", "from", "up", "down", "in", "out", "on", "off",
+    "over", "under", "again", "further", "then", "once", "here", "there",
+    "when", "where", "why", "how", "all", "any", "both", "each", "few",
+    "more", "most", "other", "some", "such", "no", "nor", "not", "only",
+    "own", "same", "so", "than", "too", "very", "s", "t", "can", "will",
+    "just", "don", "should", "now",
+]
+
+
+def _with_col(dataset: DataFrame, out_col: str, values) -> DataFrame:
+    rows = [Row(**{**r.asDict(), out_col: v})
+            for r, v in zip(dataset.collect(), values)]
+    cols = dataset.columns + ([out_col] if out_col not in dataset.columns
+                              else [])
+    return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Lowercase + split on whitespace (pyspark.ml.feature.Tokenizer)."""
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None):
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        vals = [str(r[in_col]).lower().split() for r in dataset.collect()]
+        return _with_col(dataset, out_col, vals)
+
+
+class StopWordsRemover(Transformer, HasInputCol, HasOutputCol):
+    """Filter stop words out of a string-array column. Also the class the
+    pyspark persistence carrier abuses (reference ``pipeline_util.py:30-31``);
+    here it is a real transformer."""
+
+    stopWords = Param(Params._dummy(), "stopWords", "words to filter out",
+                      typeConverter=TypeConverters.toListString)
+    caseSensitive = Param(Params._dummy(), "caseSensitive",
+                          "case sensitive comparison",
+                          typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, stopWords=None,
+                 caseSensitive=False):
+        super().__init__()
+        self._setDefault(stopWords=list(_ENGLISH_STOP_WORDS),
+                         caseSensitive=False)
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    @staticmethod
+    def loadDefaultStopWords(language: str) -> List[str]:
+        if language != "english":
+            raise ValueError("only 'english' default stop words are bundled")
+        return list(_ENGLISH_STOP_WORDS)
+
+    def getStopWords(self) -> List[str]:
+        return self.getOrDefault(self.stopWords)
+
+    def setStopWords(self, value) -> "StopWordsRemover":
+        self._set(stopWords=list(value))
+        return self
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        cs = self.getOrDefault(self.caseSensitive)
+        stop = set(self.getStopWords() if cs
+                   else [w.lower() for w in self.getStopWords()])
+        vals = []
+        for r in dataset.collect():
+            words = list(r[in_col])
+            vals.append([w for w in words
+                         if (w if cs else w.lower()) not in stop])
+        return _with_col(dataset, out_col, vals)
+
+
+class StringIndexerModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, labels=None, handleInvalid="error"):
+        super().__init__()
+        self.labels: List[str] = list(labels or [])
+        self._handle_invalid = handleInvalid
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        index = {v: float(i) for i, v in enumerate(self.labels)}
+        rows, cols = [], dataset.columns + (
+            [out_col] if out_col not in dataset.columns else [])
+        for r in dataset.collect():
+            v = str(r[in_col])
+            if v in index:
+                rows.append(Row(**{**r.asDict(), out_col: index[v]}))
+            elif self._handle_invalid == "keep":
+                rows.append(Row(**{**r.asDict(), out_col: float(len(index))}))
+            elif self._handle_invalid == "skip":
+                continue
+            else:
+                raise ValueError(f"Unseen label: {v!r} (StringIndexer "
+                                 f"handleInvalid='error')")
+        return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class StringIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Label string -> double index by descending frequency, ties broken
+    alphabetically (pyspark 2.4 'frequencyDesc' order)."""
+
+    handleInvalid = Param(Params._dummy(), "handleInvalid",
+                          "error|skip|keep for unseen labels",
+                          typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, handleInvalid="error"):
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def _fit(self, dataset: DataFrame) -> StringIndexerModel:
+        in_col = self.getOrDefault(self.inputCol)
+        counts: dict = {}
+        for r in dataset.collect():
+            v = str(r[in_col])
+            counts[v] = counts.get(v, 0) + 1
+        labels = sorted(counts, key=lambda v: (-counts[v], v))
+        m = StringIndexerModel(labels,
+                               self.getOrDefault(self.handleInvalid))
+        m._set(inputCol=in_col,
+               outputCol=self.getOrDefault(self.outputCol))
+        return m
+
+
+class StandardScalerModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, mean=None, std=None, with_mean=False, with_std=True):
+        super().__init__()
+        self.mean = np.asarray(mean) if mean is not None else None
+        self.std = np.asarray(std) if std is not None else None
+        self._with_mean, self._with_std = with_mean, with_std
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        vals = []
+        for r in dataset.collect():
+            arr = vector_to_array(r[in_col]).astype(float)
+            if self._with_mean:
+                arr = arr - self.mean
+            if self._with_std:
+                safe = np.where(self.std > 0, self.std, 1.0)
+                arr = arr / safe
+            vals.append(Vectors.dense(arr))
+        return _with_col(dataset, out_col, vals)
+
+
+class StandardScaler(Estimator, HasInputCol, HasOutputCol):
+    """Unit-variance (and optionally zero-mean) scaling; std is the UNBIASED
+    sample std, matching Spark MLlib."""
+
+    withMean = Param(Params._dummy(), "withMean", "center before scaling",
+                     typeConverter=TypeConverters.toBoolean)
+    withStd = Param(Params._dummy(), "withStd", "scale to unit std",
+                    typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, withMean=False,
+                 withStd=True):
+        super().__init__()
+        self._setDefault(withMean=False, withStd=True)
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def _fit(self, dataset: DataFrame) -> StandardScalerModel:
+        in_col = self.getOrDefault(self.inputCol)
+        mat = np.stack([vector_to_array(r[in_col]).astype(float)
+                        for r in dataset.collect()])
+        mean = mat.mean(axis=0)
+        std = mat.std(axis=0, ddof=1) if mat.shape[0] > 1 \
+            else np.zeros(mat.shape[1])
+        m = StandardScalerModel(mean, std,
+                                self.getOrDefault(self.withMean),
+                                self.getOrDefault(self.withStd))
+        m._set(inputCol=in_col, outputCol=self.getOrDefault(self.outputCol))
+        return m
+
+
+class MinMaxScalerModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, emin=None, emax=None, lo=0.0, hi=1.0):
+        super().__init__()
+        self.originalMin = np.asarray(emin) if emin is not None else None
+        self.originalMax = np.asarray(emax) if emax is not None else None
+        self._lo, self._hi = lo, hi
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        rng = self.originalMax - self.originalMin
+        vals = []
+        for r in dataset.collect():
+            arr = vector_to_array(r[in_col]).astype(float)
+            # constant features map to the midpoint (Spark semantics)
+            scaled = np.where(
+                rng != 0,
+                (arr - self.originalMin) / np.where(rng != 0, rng, 1.0)
+                * (self._hi - self._lo) + self._lo,
+                0.5 * (self._hi + self._lo))
+            vals.append(Vectors.dense(scaled))
+        return _with_col(dataset, out_col, vals)
+
+
+class MinMaxScaler(Estimator, HasInputCol, HasOutputCol):
+    min = Param(Params._dummy(), "min", "lower bound after scaling",
+                typeConverter=TypeConverters.toFloat)
+    max = Param(Params._dummy(), "max", "upper bound after scaling",
+                typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, min=0.0, max=1.0):
+        super().__init__()
+        self._setDefault(min=0.0, max=1.0)
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def _fit(self, dataset: DataFrame) -> MinMaxScalerModel:
+        in_col = self.getOrDefault(self.inputCol)
+        mat = np.stack([vector_to_array(r[in_col]).astype(float)
+                        for r in dataset.collect()])
+        m = MinMaxScalerModel(mat.min(axis=0), mat.max(axis=0),
+                              self.getOrDefault(self.min),
+                              self.getOrDefault(self.max))
+        m._set(inputCol=in_col, outputCol=self.getOrDefault(self.outputCol))
+        return m
+
+
+class Bucketizer(Transformer, HasInputCol, HasOutputCol):
+    """Map a continuous column into bucket indices given split points;
+    the last bucket includes its upper bound (pyspark semantics)."""
+
+    splits = Param(Params._dummy(), "splits", "bucket split points",
+                   typeConverter=TypeConverters.toListFloat)
+    handleInvalid = Param(Params._dummy(), "handleInvalid",
+                          "error|skip|keep for NaN entries",
+                          typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, splits=None, inputCol=None, outputCol=None,
+                 handleInvalid="error"):
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        splits = list(self.getOrDefault(self.splits))
+        hi_mode = self.getOrDefault(self.handleInvalid)
+        n_buckets = len(splits) - 1
+        rows, cols = [], dataset.columns + (
+            [out_col] if out_col not in dataset.columns else [])
+        for r in dataset.collect():
+            v = float(r[in_col])
+            if np.isnan(v):
+                # Spark 2.4: handleInvalid governs NaN entries ONLY
+                if hi_mode == "keep":
+                    b = float(n_buckets)
+                elif hi_mode == "skip":
+                    continue
+                else:
+                    raise ValueError("NaN value in Bucketizer input "
+                                     "(handleInvalid='error')")
+            elif v == splits[-1]:
+                b = float(n_buckets - 1)
+            elif splits[0] <= v < splits[-1]:
+                b = float(int(np.searchsorted(splits, v, side="right")) - 1)
+            else:
+                # out-of-range is an error regardless of handleInvalid
+                # (Spark 2.4 semantics)
+                raise ValueError(f"value {v} out of bucket range "
+                                 f"[{splits[0]}, {splits[-1]}]")
+            rows.append(Row(**{**r.asDict(), out_col: b}))
+        return DataFrame(rows, cols, dataset.num_partitions)

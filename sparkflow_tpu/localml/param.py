@@ -55,6 +55,18 @@ class TypeConverters:
         return [str(x) for x in v]
 
     @staticmethod
+    def toListFloat(v):
+        if v is None:
+            return None
+        return [float(x) for x in v]
+
+    @staticmethod
+    def toListInt(v):
+        if v is None:
+            return None
+        return [int(x) for x in v]
+
+    @staticmethod
     def identity(v):
         return v
 

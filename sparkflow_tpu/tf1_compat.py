@@ -476,7 +476,8 @@ class _Evaluator:
             return jnp.clip(jnp.asarray(x), lo, hi)
 
         # --- linear algebra / nn ---
-        if op in ("Conv2D", "MaxPool", "AvgPool", "BiasAdd"):
+        if op in ("Conv2D", "MaxPool", "AvgPool", "BiasAdd",
+                  "DepthwiseConv2dNative"):
             fmt = attr.get("data_format", {}).get("s")
             if fmt and _b64str(fmt) not in ("NHWC", ""):
                 raise NotImplementedError(

@@ -1,0 +1,170 @@
+"""Round-2 localml widening: the rest of the pyspark.ml.feature subset
+(Tokenizer, StopWordsRemover, StringIndexer, StandardScaler, MinMaxScaler,
+Bucketizer) + BinaryClassificationEvaluator. Semantics follow pyspark 2.4,
+the reference's pinned Spark (reference ``environment.yml:15``)."""
+
+import numpy as np
+import pytest
+
+from sparkflow_tpu.localml import (
+    Bucketizer, BinaryClassificationEvaluator, LocalSession, MinMaxScaler,
+    Pipeline, StandardScaler, StopWordsRemover, StringIndexer, Tokenizer,
+    Vectors)
+
+
+@pytest.fixture(scope="module")
+def spark():
+    return LocalSession.builder.getOrCreate()
+
+
+def test_tokenizer_and_stopwords(spark):
+    df = spark.createDataFrame(
+        [("The quick brown Fox",), ("IS this THE real life",)], ["text"])
+    tok = Tokenizer(inputCol="text", outputCol="words")
+    sw = StopWordsRemover(inputCol="words", outputCol="filtered")
+    out = sw.transform(tok.transform(df)).collect()
+    assert out[0]["words"] == ["the", "quick", "brown", "fox"]
+    assert out[0]["filtered"] == ["quick", "brown", "fox"]
+    assert out[1]["filtered"] == ["real", "life"]
+
+
+def test_stopwords_case_sensitive_and_custom(spark):
+    df = spark.createDataFrame([(["Keep", "keep", "drop"],)], ["words"])
+    sw = StopWordsRemover(inputCol="words", outputCol="out",
+                          stopWords=["keep"], caseSensitive=True)
+    assert sw.transform(df).collect()[0]["out"] == ["Keep", "drop"]
+    assert "the" in StopWordsRemover.loadDefaultStopWords("english")
+
+
+def test_string_indexer_frequency_order(spark):
+    df = spark.createDataFrame(
+        [("b",), ("a",), ("b",), ("c",), ("b",), ("a",)], ["cat"])
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    assert model.labels == ["b", "a", "c"]  # freq desc, ties alphabetical
+    got = {r["cat"]: r["idx"] for r in model.transform(df).collect()}
+    assert got == {"b": 0.0, "a": 1.0, "c": 2.0}
+
+
+def test_string_indexer_handle_invalid(spark):
+    train = spark.createDataFrame([("a",), ("b",)], ["cat"])
+    test = spark.createDataFrame([("a",), ("z",)], ["cat"])
+    with pytest.raises(ValueError, match="Unseen label"):
+        StringIndexer(inputCol="cat", outputCol="idx").fit(train) \
+            .transform(test).collect()
+    keep = StringIndexer(inputCol="cat", outputCol="idx",
+                         handleInvalid="keep").fit(train).transform(test)
+    assert [r["idx"] for r in keep.collect()] == [0.0, 2.0]
+    skip = StringIndexer(inputCol="cat", outputCol="idx",
+                         handleInvalid="skip").fit(train).transform(test)
+    assert [r["cat"] for r in skip.collect()] == ["a"]
+
+
+def test_standard_scaler_matches_numpy(spark):
+    rs = np.random.RandomState(0)
+    mat = rs.rand(20, 3) * np.array([1.0, 10.0, 100.0]) + 5
+    df = spark.createDataFrame([(Vectors.dense(row),) for row in mat], ["f"])
+    m = StandardScaler(inputCol="f", outputCol="s", withMean=True,
+                       withStd=True).fit(df)
+    out = np.stack([np.asarray(r["s"].toArray())
+                    for r in m.transform(df).collect()])
+    expect = (mat - mat.mean(0)) / mat.std(0, ddof=1)
+    np.testing.assert_allclose(out, expect, atol=1e-12)
+    # default: withMean=False
+    m2 = StandardScaler(inputCol="f", outputCol="s").fit(df)
+    out2 = np.stack([np.asarray(r["s"].toArray())
+                     for r in m2.transform(df).collect()])
+    np.testing.assert_allclose(out2, mat / mat.std(0, ddof=1), atol=1e-12)
+
+
+def test_min_max_scaler_with_constant_feature(spark):
+    mat = np.array([[0.0, 7.0], [5.0, 7.0], [10.0, 7.0]])
+    df = spark.createDataFrame([(Vectors.dense(row),) for row in mat], ["f"])
+    m = MinMaxScaler(inputCol="f", outputCol="s").fit(df)
+    out = np.stack([np.asarray(r["s"].toArray())
+                    for r in m.transform(df).collect()])
+    np.testing.assert_allclose(out[:, 0], [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(out[:, 1], [0.5, 0.5, 0.5])  # constant -> mid
+
+
+def test_bucketizer(spark):
+    df = spark.createDataFrame([(x,) for x in [-0.5, 0.0, 0.4, 1.0, 2.0]],
+                               ["v"])
+    b = Bucketizer(splits=[-1.0, 0.0, 1.0, 2.0], inputCol="v",
+                   outputCol="bucket")
+    got = [r["bucket"] for r in b.transform(df).collect()]
+    assert got == [0.0, 1.0, 1.0, 2.0, 2.0]  # upper bound inclusive at end
+    # out-of-range ALWAYS raises (Spark 2.4), even with handleInvalid=keep
+    oob = spark.createDataFrame([(99.0,)], ["v"])
+    with pytest.raises(ValueError, match="out of bucket range"):
+        b.transform(oob).collect()
+    b_keep = Bucketizer(splits=[-1.0, 0.0, 1.0, 2.0], inputCol="v",
+                        outputCol="bucket", handleInvalid="keep")
+    with pytest.raises(ValueError, match="out of bucket range"):
+        b_keep.transform(oob).collect()
+    # handleInvalid governs NaN entries only: keep -> extra bucket
+    nan_df = spark.createDataFrame([(float("nan"),)], ["v"])
+    assert b_keep.transform(nan_df).collect()[0]["bucket"] == 3.0
+    with pytest.raises(ValueError, match="NaN"):
+        b.transform(nan_df).collect()
+
+
+def test_binary_evaluator_auc(spark):
+    # perfectly separable scores -> AUC 1; anti-separable -> 0
+    rows = [(1.0, 0.9), (1.0, 0.8), (0.0, 0.2), (0.0, 0.1)]
+    df = spark.createDataFrame(rows, ["label", "rawPrediction"])
+    ev = BinaryClassificationEvaluator()
+    assert ev.evaluate(df) == pytest.approx(1.0)
+    rows = [(0.0, 0.9), (0.0, 0.8), (1.0, 0.2), (1.0, 0.1)]
+    assert ev.evaluate(
+        spark.createDataFrame(rows, ["label", "rawPrediction"])) \
+        == pytest.approx(0.0)
+    # random-ish interleave: AUC strictly between
+    rows = [(1.0, 0.9), (0.0, 0.8), (1.0, 0.7), (0.0, 0.6)]
+    auc = ev.evaluate(spark.createDataFrame(rows, ["label", "rawPrediction"]))
+    assert auc == pytest.approx(0.75)
+    # tied scores get half credit and the result is row-order independent
+    ties = [(1.0, 0.5), (0.0, 0.5)]
+    assert ev.evaluate(
+        spark.createDataFrame(ties, ["label", "rawPrediction"])) \
+        == pytest.approx(0.5)
+    assert ev.evaluate(
+        spark.createDataFrame(ties[::-1], ["label", "rawPrediction"])) \
+        == pytest.approx(0.5)
+    # vector scores: last component is the positive-class score
+    rows = [(1.0, Vectors.dense([0.1, 0.9])), (0.0, Vectors.dense([0.9, 0.1]))]
+    assert ev.evaluate(
+        spark.createDataFrame(rows, ["label", "rawPrediction"])) \
+        == pytest.approx(1.0)
+    # areaUnderPR on separable data is 1
+    ev_pr = BinaryClassificationEvaluator(metricName="areaUnderPR")
+    rows = [(1.0, 0.9), (1.0, 0.8), (0.0, 0.2), (0.0, 0.1)]
+    assert ev_pr.evaluate(
+        spark.createDataFrame(rows, ["label", "rawPrediction"])) \
+        == pytest.approx(1.0)
+
+
+def test_text_pipeline_end_to_end(spark):
+    """Tokenize -> remove stop words -> index a label -> all inside a
+    Pipeline; the save/load round-trip goes through the localml dill path."""
+    import tempfile
+
+    rows = [("the good movie", "pos"), ("a bad film", "neg"),
+            ("good good film", "pos"), ("the bad one", "neg")]
+    df = spark.createDataFrame(rows, ["text", "sentiment"])
+    pipe = Pipeline(stages=[
+        Tokenizer(inputCol="text", outputCol="words"),
+        StopWordsRemover(inputCol="words", outputCol="filtered"),
+        StringIndexer(inputCol="sentiment", outputCol="label"),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df).collect()
+    assert out[0]["filtered"] == ["good", "movie"]
+    assert {r["label"] for r in out} == {0.0, 1.0}
+
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/pipe"
+        model.write().overwrite().save(path)
+        from sparkflow_tpu.localml import PipelineModel
+        loaded = PipelineModel.load(path)
+        again = loaded.transform(df).collect()
+        assert [r["label"] for r in again] == [r["label"] for r in out]
