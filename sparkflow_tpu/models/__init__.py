@@ -15,7 +15,8 @@ format the Estimator's ``tensorflowGraph`` Param carries):
 Families: ``mlp``, ``cnn``, ``autoencoder`` (graph-DSL preset builders mirroring
 the reference examples), ``transformer_classifier`` / ``transformer_lm`` (BERT
 -class encoder, flash/ring attention, TP/SP shardings), ``resnet50`` (CIFAR/
-ImageNet residual network, stateless norm).
+ImageNet residual network, stateless norm), ``rnn_classifier`` / ``rnn_lm``
+(LSTM/GRU via lax.scan, fused gate matmuls).
 """
 
 from .registry import model_from_json, register_model, build_registry_spec
@@ -23,8 +24,10 @@ from . import presets
 from .transformer import TransformerClassifier, TransformerLM
 from .moe import MoETransformerLM
 from .resnet import ResNet
+from .rnn import RNNClassifier, RNNLM
 
 __all__ = [
     "model_from_json", "register_model", "build_registry_spec", "presets",
     "TransformerClassifier", "TransformerLM", "MoETransformerLM", "ResNet",
+    "RNNClassifier", "RNNLM",
 ]

@@ -90,3 +90,20 @@ class RegistryModel:
 def _initializer(name: str):
     from ..graphdef import _get_initializer
     return _get_initializer(name)
+
+
+def softmax_xent(logits, y):
+    """Per-example softmax cross entropy accepting EITHER one-hot labels
+    [N, C] or class-index labels ([N] / [N, 1] — what the estimator's
+    scalar ``labelCol`` marshalling produces, reference ``ml_util.py:
+    86-101``). Index labels are one-hot'd here; without this, a [N, 1]
+    label column silently broadcasts against [N, C] logits and the loss
+    is meaningless."""
+    y = jnp.asarray(y)
+    c = logits.shape[-1]
+    if y.ndim == logits.ndim and y.shape[-1] == c:
+        onehot = y.astype(jnp.float32)
+    else:
+        idx = y.reshape(y.shape[0]).astype(jnp.int32)
+        onehot = jax.nn.one_hot(idx, c, dtype=jnp.float32)
+    return -jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)

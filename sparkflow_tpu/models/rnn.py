@@ -1,0 +1,270 @@
+"""Recurrent models (LSTM / GRU) — ``lax.scan`` over time, MXU-shaped steps.
+
+The reference has no sequence models at all (SURVEY.md §5: "no attention, no
+sequence model, no notion of sequence length anywhere"); this family is a
+capability upgrade in the reference's TF1 era idiom (``tf.nn.dynamic_rnn``-class
+models), designed TPU-first:
+
+- the whole recurrence is ONE ``lax.scan`` per layer — a single compiled loop,
+  no per-step dispatch, static shapes throughout;
+- each step does ONE fused gate matmul ``[B, D+H] @ [D+H, G*H]`` (G=4 for
+  LSTM, 3 for GRU) so the MXU sees a large batched GEMM instead of G small
+  ones; operands run in the compute dtype (bf16 on TPU) with f32 accumulation
+  and f32 cell state;
+- padded timesteps (``attention_mask`` 0) carry state through unchanged, so
+  the final carry IS the last-valid-step hidden state — no gather needed for
+  the classifier head;
+- recurrent kernels are deliberately replicated in ``param_pspecs`` (P()):
+  column-sharding the gate matmul over ``tp`` would need an all-gather of the
+  hidden state every timestep — serial ICI latency the scan cannot hide.
+  Scale RNNs with dp/fsdp instead (``fsdp_pspecs`` shards these kernels fine:
+  parameters all-gather ONCE per step function, not per timestep).
+
+Registry names: ``rnn_classifier`` (uni/bi-directional encoder + softmax head),
+``rnn_lm`` (next-token LM, tied embeddings).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .base import RegistryModel, _Names, softmax_xent
+from .registry import register_model
+
+
+def _gate_matmul(xh, kernel, bias):
+    """[B, D+H] @ [D+H, G*H] in compute dtype, f32 accumulation."""
+    y = jnp.matmul(xh, kernel.astype(xh.dtype),
+                   preferred_element_type=jnp.float32)
+    return y + bias.astype(jnp.float32)
+
+
+def _lstm_scan(x, mask, h0, c0, kernel, bias):
+    """x [S,B,D], mask [S,B,1] or None -> (ys [S,B,H], h_last, c_last).
+
+    Cell state stays f32; the forget gate gets the standard +1 bias so
+    gradients flow at init (Jozefowicz et al.)."""
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = _gate_matmul(jnp.concatenate([xt, h.astype(xt.dtype)], -1),
+                             kernel, bias)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + 1.0)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if mt is not None:
+            c_new = jnp.where(mt > 0, c_new, c)
+            h_new = jnp.where(mt > 0, h_new, h)
+        return (h_new, c_new), h_new
+
+    (h, c), ys = jax.lax.scan(step, (h0.astype(jnp.float32),
+                                     c0.astype(jnp.float32)),
+                              (x, mask))
+    return ys, h, c
+
+
+def _gru_scan(x, mask, h0, kernel, bias):
+    """x [S,B,D] -> (ys [S,B,H], h_last). Gate layout [z, r, n]; the
+    candidate uses r*h (v3/cuDNN-style reset-after on the hidden input)."""
+    hdim = h0.shape[-1]
+
+    def step(h, inp):
+        xt, mt = inp
+        zr_n = _gate_matmul(jnp.concatenate([xt, h.astype(xt.dtype)], -1),
+                            kernel, bias)
+        z = jax.nn.sigmoid(zr_n[..., :hdim])
+        r = jax.nn.sigmoid(zr_n[..., hdim:2 * hdim])
+        # candidate re-reads the hidden through the reset gate: one extra
+        # small matmul against the n-slice of the recurrent kernel
+        xdim = xt.shape[-1]
+        n_x = zr_n[..., 2 * hdim:]  # includes h contribution; remove it
+        w_hn = kernel[xdim:, 2 * hdim:]
+        h_contrib = jnp.matmul(h.astype(xt.dtype), w_hn.astype(xt.dtype),
+                               preferred_element_type=jnp.float32)
+        n = jnp.tanh(n_x - h_contrib + r * h_contrib)
+        h_new = (1.0 - z) * n + z * h
+        if mt is not None:
+            h_new = jnp.where(mt > 0, h_new, h)
+        return h_new, h_new
+
+    h, ys = jax.lax.scan(step, h0.astype(jnp.float32), (x, mask))
+    return ys, h
+
+
+class _RNNBase(RegistryModel):
+    def __init__(self, vocab_size: int, hidden: int = 512,
+                 num_layers: int = 2, max_len: int = 128,
+                 cell: str = "lstm", dropout: float = 0.0,
+                 embed_dim: Optional[int] = None, compute_dtype=None):
+        if cell not in ("lstm", "gru"):
+            raise ValueError(f"cell must be 'lstm' or 'gru', got {cell!r}")
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.max_len = max_len
+        self.cell = cell
+        self.dropout = dropout
+        self.embed_dim = embed_dim or hidden
+        super().__init__(compute_dtype)
+
+    @property
+    def _gates(self):
+        return 4 if self.cell == "lstm" else 3
+
+    def input_specs(self):
+        return {"input_ids": ((None, self.max_len), "int32"),
+                "attention_mask": ((None, self.max_len), "float32")}
+
+    def _layer_specs(self, in_dim):
+        g, h = self._gates, self.hidden
+        return {"kernel": ((in_dim + h, g * h), "normal(0.02)"),
+                "bias": ((g * h,), "zeros")}
+
+    def param_specs(self):
+        specs = {"embed": {"tok": ((self.vocab_size, self.embed_dim),
+                                   "normal(0.02)")}}
+        in_dim = self.embed_dim
+        for i in range(self.num_layers):
+            specs[f"layer_{i}"] = self._layer_specs(in_dim)
+            in_dim = self.hidden
+        return specs
+
+    def param_pspecs(self):
+        # recurrent kernels replicated by design (see module docstring)
+        return {name: {k: P() for k in layer}
+                for name, layer in self.param_specs().items()}
+
+    def _dropout(self, x, train, rng):
+        if not train or self.dropout <= 0.0 or rng is None:
+            return x, rng
+        rng, sub = jax.random.split(rng)
+        keep = 1.0 - self.dropout
+        m = jax.random.bernoulli(sub, keep, x.shape)
+        return jnp.where(m, x / keep, 0).astype(x.dtype), rng
+
+    def _run_layer(self, lp, x, mask, reverse=False):
+        """x [S,B,D] -> (ys [S,B,H], h_last [B,H]) through one scan."""
+        if reverse:
+            x = jnp.flip(x, 0)
+            mask = jnp.flip(mask, 0) if mask is not None else None
+        b = x.shape[1]
+        h0 = jnp.zeros((b, self.hidden), jnp.float32)
+        if self.cell == "lstm":
+            ys, h, _ = _lstm_scan(x, mask, h0, h0, lp["kernel"], lp["bias"])
+        else:
+            ys, h = _gru_scan(x, mask, h0, lp["kernel"], lp["bias"])
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys.astype(x.dtype), h
+
+    def _encode(self, params, feeds, train, rng, suffix="", reverse=False):
+        """Run the stacked recurrence over ``layer_{i}{suffix}`` params.
+        Returns (ys [S,B,H] f-compute, h_last [B,H] f32, advanced rng)."""
+        ids = feeds["input_ids"].astype(jnp.int32)
+        mask = feeds.get("attention_mask")
+        x = self.cast(jnp.take(params["embed"]["tok"], ids, axis=0))
+        x = jnp.transpose(x, (1, 0, 2))  # [S,B,D] for the scan
+        m = (jnp.transpose(mask, (1, 0))[:, :, None].astype(jnp.float32)
+             if mask is not None else None)
+        h_last = None
+        for i in range(self.num_layers):
+            x, h_last = self._run_layer(params[f"layer_{i}{suffix}"], x, m,
+                                        reverse=reverse)
+            x, rng = self._dropout(x, train, rng)
+        return x, h_last, rng
+
+
+@register_model("rnn_classifier")
+class RNNClassifier(_RNNBase):
+    """Uni- or bi-directional recurrent encoder + softmax head. The head
+    reads the last VALID hidden state (padding carries state through), plus
+    the reverse-direction final state when ``bidirectional``."""
+
+    def __init__(self, vocab_size: int, num_classes: int,
+                 bidirectional: bool = False, **kw):
+        self.num_classes = num_classes
+        self.bidirectional = bidirectional
+        super().__init__(vocab_size, **kw)
+        self.TENSORS = ("input_ids", "attention_mask", "y", "logits",
+                        "probs", "pred")
+        self.graphdef = _Names(self.TENSORS)
+
+    def input_specs(self):
+        specs = super().input_specs()
+        specs["y"] = ((None, self.num_classes), "float32")
+        return specs
+
+    def param_specs(self):
+        specs = super().param_specs()
+        if self.bidirectional:
+            in_dim = self.embed_dim
+            for i in range(self.num_layers):
+                specs[f"layer_{i}_rev"] = self._layer_specs(in_dim)
+                in_dim = self.hidden
+        feat = self.hidden * (2 if self.bidirectional else 1)
+        specs["head"] = {"kernel": ((feat, self.num_classes), "normal(0.02)"),
+                         "bias": ((self.num_classes,), "zeros")}
+        return specs
+
+    def _forward(self, params, feeds, train, rng):
+        _, h, rng = self._encode(params, feeds, train, rng)
+        if self.bidirectional:
+            # rng advanced by the forward stack: reverse-direction dropout
+            # masks are independent of the forward ones
+            _, h_rev, rng = self._encode(params, feeds, train, rng,
+                                         suffix="_rev", reverse=True)
+            h = jnp.concatenate([h, h_rev], axis=-1)
+        logits = (jnp.matmul(h, params["head"]["kernel"])
+                  + params["head"]["bias"])
+        return {"logits": logits,
+                "probs": jax.nn.softmax(logits, axis=-1),
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        return softmax_xent(logits, feeds["y"])
+
+
+@register_model("rnn_lm")
+class RNNLM(_RNNBase):
+    """Next-token recurrent LM with tied input/output embeddings (the
+    classic TF1-era ``dynamic_rnn`` + sampled-softmax shape, full softmax
+    here). Loss masks padded positions per-example like the transformer LM."""
+
+    def __init__(self, vocab_size: int, **kw):
+        super().__init__(vocab_size, **kw)
+        self.TENSORS = ("input_ids", "attention_mask", "logits", "pred")
+        self.graphdef = _Names(self.TENSORS)
+        if self.embed_dim != self.hidden:
+            raise ValueError("rnn_lm ties embeddings: embed_dim must equal "
+                             f"hidden ({self.embed_dim} != {self.hidden})")
+
+    def _forward(self, params, feeds, train, rng):
+        ys, _, _ = self._encode(params, feeds, train, rng)  # [S,B,H]
+        x = jnp.transpose(ys, (1, 0, 2)).astype(jnp.float32)  # [B,S,H]
+        logits = jnp.matmul(x, params["embed"]["tok"].T.astype(jnp.float32))
+        return {"logits": logits,
+                "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    def _loss(self, params, feeds, train, rng):
+        logits = self._forward(params, feeds, train, rng)["logits"]
+        ids = feeds["input_ids"].astype(jnp.int32)
+        mask = feeds.get("attention_mask")
+        targets = ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tok_ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is not None:
+            w = mask[:, 1:].astype(jnp.float32)
+        else:
+            w = jnp.ones_like(tok_ll)
+        return -jnp.sum(tok_ll * w, axis=-1) / jnp.maximum(
+            jnp.sum(w, axis=-1), 1e-6)

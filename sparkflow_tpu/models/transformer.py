@@ -221,9 +221,9 @@ class TransformerClassifier(_TransformerBase):
                 "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
 
     def _loss(self, params, feeds, train, rng):
+        from .base import softmax_xent
         logits = self._forward(params, feeds, train, rng)["logits"]
-        y = feeds["y"].astype(jnp.float32)
-        return -jnp.sum(y * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        return softmax_xent(logits, feeds["y"])
 
 
 @register_model("transformer_lm")

@@ -394,7 +394,10 @@ def test_multi_input_transformer_through_estimator(spark):
     preds = model.transform(df)
     errs = sum(1 for r in preds.collect()
                if round(float(r["predicted"])) != float(r["label"]))
-    assert errs < 60  # learned something better than all-wrong
+    # scalar labelCol now feeds the index path of models.base.softmax_xent
+    # (a [N,1] label against [N,2] logits previously broadcast to a
+    # meaningless loss, and this assertion was vacuously loose)
+    assert errs < 15
 
 
 def test_extra_inputs_param_validation(spark, gaussian_df):

@@ -1,0 +1,185 @@
+"""Recurrent model family (models/rnn.py): cell numerics vs a numpy
+reference, masking semantics, training via the Trainer, estimator
+integration. A capability upgrade over the reference (SURVEY.md §5: no
+sequence models exist there)."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparkflow_tpu.models import build_registry_spec, model_from_json
+from sparkflow_tpu.trainer import Trainer
+
+TINY = dict(vocab_size=32, hidden=16, num_layers=1, max_len=8)
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, mask, kernel, bias):
+    """Numpy reference of _lstm_scan (f32, forget-gate +1 bias)."""
+    S, B, D = x.shape
+    H = kernel.shape[1] // 4
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    ys = []
+    for t in range(S):
+        gates = np.concatenate([x[t], h], -1) @ kernel + bias
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f = _np_sigmoid(i), _np_sigmoid(f + 1.0)
+        g, o = np.tanh(g), _np_sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        if mask is not None:
+            c = np.where(mask[t] > 0, c_new, c)
+            h = np.where(mask[t] > 0, h_new, h)
+        else:
+            c, h = c_new, h_new
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def _np_gru(x, mask, kernel, bias):
+    """Numpy reference of _gru_scan: n = tanh(W_in x + b_n + r*(W_hn h))."""
+    S, B, D = x.shape
+    H = kernel.shape[1] // 3
+    h = np.zeros((B, H), np.float32)
+    ys = []
+    for t in range(S):
+        zr_n = np.concatenate([x[t], h], -1) @ kernel + bias
+        z = _np_sigmoid(zr_n[..., :H])
+        r = _np_sigmoid(zr_n[..., H:2 * H])
+        h_contrib = h @ kernel[D:, 2 * H:]
+        n = np.tanh(zr_n[..., 2 * H:] - h_contrib + r * h_contrib)
+        h_new = (1.0 - z) * n + z * h
+        h = np.where(mask[t] > 0, h_new, h) if mask is not None else h_new
+        ys.append(h)
+    return np.stack(ys), h
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru"])
+def test_scan_matches_numpy_reference(cell):
+    from sparkflow_tpu.models.rnn import _gru_scan, _lstm_scan
+
+    rs = np.random.RandomState(0)
+    S, B, D, H = 6, 3, 5, 4
+    g = 4 if cell == "lstm" else 3
+    x = rs.randn(S, B, D).astype(np.float32)
+    mask = (rs.rand(S, B, 1) > 0.3).astype(np.float32)
+    kernel = (rs.randn(D + H, g * H) * 0.3).astype(np.float32)
+    bias = (rs.randn(g * H) * 0.1).astype(np.float32)
+    h0 = np.zeros((B, H), np.float32)
+
+    if cell == "lstm":
+        ys, h, c = _lstm_scan(x, mask, h0, h0, kernel, bias)
+        np_ys, np_h, np_c = _np_lstm(x, mask, kernel, bias)
+        np.testing.assert_allclose(np.asarray(c), np_c, atol=1e-5)
+    else:
+        ys, h = _gru_scan(x, mask, h0, kernel, bias)
+        np_ys, np_h = _np_gru(x, mask, kernel, bias)
+    np.testing.assert_allclose(np.asarray(ys), np_ys, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), np_h, atol=1e-5)
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru"])
+def test_padding_carries_last_valid_state(cell):
+    """Forward on a padded batch == forward on the trimmed sequence: the
+    classifier head reads the last VALID state, not the last slot."""
+    spec = build_registry_spec("rnn_classifier", num_classes=2, cell=cell,
+                               **TINY)
+    m = model_from_json(spec)
+    params = m.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(1)
+    ids = rs.randint(0, 32, (2, 8)).astype(np.float32)
+    mask = np.ones((2, 8), np.float32)
+    mask[:, 5:] = 0.0  # only 5 valid steps
+    full = m.apply(params, {"input_ids": ids, "attention_mask": mask},
+                   ["logits"])["logits"]
+    # trimmed: same 5 steps, mask all-ones
+    short = m.apply(params, {"input_ids": ids[:, :5],
+                             "attention_mask": mask[:, :5]},
+                    ["logits"])["logits"]
+    np.testing.assert_allclose(np.asarray(full), np.asarray(short), atol=1e-5)
+
+
+def test_rnn_classifier_trains():
+    spec = build_registry_spec("rnn_classifier", num_classes=2, **TINY)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 32, (128, 8)).astype(np.float32)
+    labels = (ids[:, 0] > 15).astype(int)  # first-token rule
+    y = np.eye(2)[labels].astype(np.float32)
+    tr = Trainer(spec, "input_ids:0", "y:0", iters=40, mini_batch_size=32,
+                 learning_rate=5e-3, optimizer="adam")
+    res = tr.fit(ids, y)
+    assert res.losses[-1] < res.losses[0] * 0.8
+    from sparkflow_tpu.core import predict_in_chunks
+    preds = predict_in_chunks(tr.predict_fn("pred:0"), res.params, ids)
+    assert (preds == labels).mean() > 0.8
+
+
+def test_rnn_bidirectional_beats_shapes():
+    spec = build_registry_spec("rnn_classifier", num_classes=3,
+                               bidirectional=True, cell="gru", **TINY)
+    m = model_from_json(spec)
+    params = m.init(jax.random.PRNGKey(0))
+    assert "layer_0_rev" in params
+    rs = np.random.RandomState(2)
+    ids = rs.randint(0, 32, (4, 8)).astype(np.float32)
+    out = m.apply(params, {"input_ids": ids}, ["logits", "probs"])
+    assert np.asarray(out["logits"]).shape == (4, 3)
+    np.testing.assert_allclose(np.asarray(out["probs"]).sum(-1), 1.0,
+                               atol=1e-5)
+
+
+def test_rnn_lm_trains_and_masks_padding():
+    spec = build_registry_spec("rnn_lm", **TINY)
+    m = model_from_json(spec)
+    params = m.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 32, (4, 8)).astype(np.float32)
+    mask = np.ones((4, 8), np.float32)
+    mask[:, 6:] = 0.0
+    # loss over the padded batch equals loss over the trimmed batch
+    lv_full = np.asarray(m.loss_vector(
+        params, {"input_ids": ids, "attention_mask": mask}, train=False))
+    lv_trim = np.asarray(m.loss_vector(
+        params, {"input_ids": ids[:, :6], "attention_mask": mask[:, :6]},
+        train=False))
+    np.testing.assert_allclose(lv_full, lv_trim, atol=1e-5)
+
+    # repeated-token sequences are learnable
+    ids = np.tile(rs.randint(0, 32, (64, 1)), (1, 8)).astype(np.float32)
+    tr = Trainer(spec, "input_ids:0", None, iters=60, mini_batch_size=32,
+                 learning_rate=1e-2, optimizer="adam")
+    res = tr.fit(ids, None)
+    assert res.losses[-1] < res.losses[0] * 0.5
+
+
+def test_rnn_via_estimator_with_mask_column():
+    """rnn_classifier from the Spark surface, mask fed via extraInputCols."""
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL
+
+    spark = LocalSession.builder.getOrCreate()
+    rs = np.random.RandomState(4)
+    rows = []
+    for _ in range(96):
+        n_valid = rs.randint(3, 9)
+        ids = np.zeros(8)
+        ids[:n_valid] = rs.randint(1, 32, n_valid)
+        label = float(ids[0] > 15)
+        mask = (ids > 0).astype(float)
+        rows.append((Vectors.dense(ids), Vectors.dense(mask), label))
+    df = spark.createDataFrame(rows, ["ids", "mask", "label"])
+    spec = build_registry_spec("rnn_classifier", num_classes=2, **TINY)
+    est = SparkAsyncDL(inputCol="ids", tensorflowGraph=spec,
+                       tfInput="input_ids:0", tfLabel="y:0", labelCol="label",
+                       tfOutput="pred:0", extraInputCols="mask",
+                       extraTfInputs="attention_mask:0",
+                       iters=60, miniBatchSize=32, tfOptimizer="adam",
+                       tfLearningRate=1e-2, predictionCol="pred")
+    model = est.fit(df)
+    out = model.transform(df).collect()
+    acc = np.mean([float(r["pred"]) == r["label"] for r in out])
+    assert acc > 0.8
