@@ -167,8 +167,7 @@ def bench_flash_attention():
         float(many(inp))
         return (time.perf_counter() - t0) / ITERS
 
-    tf = _timed(lambda q: flash_attention(q, q, q, causal=True, block_q=512,
-                                          block_k=512).astype(jnp.float32).sum())
+    tf = _timed(lambda q: flash_attention(q, q, q, causal=True).astype(jnp.float32).sum())
     tr = _timed(lambda q: attention_reference(q, q, q, causal=True)
                 .astype(jnp.float32).sum())
     _emit("flash_attention_vs_xla", tr / tf, "speedup_x",
@@ -177,7 +176,7 @@ def bench_flash_attention():
     # fwd+bwd: the training-path comparison (pallas dq/dk/dv kernels vs
     # XLA autodiff of the dense reference)
     tfg = _timed(lambda q: jax.grad(lambda a: flash_attention(
-        a, a, a, causal=True, block_q=512, block_k=512).astype(jnp.float32)
+        a, a, a, causal=True).astype(jnp.float32)
         .sum())(q).astype(jnp.float32).sum())
     trg = _timed(lambda q: jax.grad(lambda a: attention_reference(a, a, a,
         causal=True).astype(jnp.float32).sum())(q).astype(jnp.float32).sum())

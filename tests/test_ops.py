@@ -323,3 +323,29 @@ def test_flash_lowers_on_tpu():  # pragma: no cover (CPU suite skips)
             g = jax.grad(lambda a: flash_attention(
                 a, a, a, causal=causal, kv_mask=m, interpret=False).sum())(q)
             assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_auto_block_selection():
+    """Auto block choice: per-dimension, per-path, short-seq clamp."""
+    from sparkflow_tpu.ops.attention import _auto_block
+
+    assert _auto_block(4096, 1024) == 1024
+    assert _auto_block(4096, 512) == 512
+    assert _auto_block(384, 1024) == 128   # 384 = 3*128
+    assert _auto_block(64, 1024) == 64     # short seq: the old min(128, s)
+    assert _auto_block(320, 1024) == 128   # 320 % 128 != 0 -> kernel falls back
+
+
+def test_flash_short_query_cross_attention_keeps_kernel():
+    """s=64 queries against sk=256 keys still runs the (interpret) pallas
+    kernel via the short-seq clamp, matching the reference numerics."""
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.ops import attention_reference, flash_attention
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 2, 64, 32), jnp.float32)
+    kv = jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32)
+    out = flash_attention(q, kv, kv, causal=False, interpret=True)
+    ref = attention_reference(q, kv, kv, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
