@@ -46,4 +46,5 @@ clean:
 examples-extra:
 	cd examples && PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python text_classifier.py && \
 	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python bert_classifier.py && \
-	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python tf1_migration.py
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python tf1_migration.py && \
+	PYTHONPATH="..:$$PYTHONPATH" SPARKFLOW_TPU_SMOKE=1 python rnn_sequence.py
