@@ -553,6 +553,11 @@ class Trainer:
                            seed=self.seed + epoch)
             feeder = feed_from_iterator(q, _it.chain([first], it), supervised,
                                         chunk)
+            # NOTE on overlap: the step dispatch is async (JAX enqueues the
+            # computation and the arg transfers), so the device runs batch N
+            # while this loop pops/assembles batch N+1 — an explicit
+            # device_put lookahead would only delay step N's dispatch behind
+            # the (possibly slow) pop of N+1
             try:
                 for x, y, mask, n_real in q:
                     rng, srng = jax.random.split(rng)
