@@ -208,8 +208,27 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
     lr = _pop(opts, "learning_rate", default=learning_rate if learning_rate is not None else 0.001)
     schedule = opts.pop("schedule", None)     # upgrade: LR schedules (below)
     accum = int(opts.pop("grad_accum_steps", 0) or 0)
+    # upgrade keys: gradient clipping (applied to the raw gradient, BEFORE
+    # the optimizer sees it) and decoupled weight decay (AdamW-style,
+    # applied with the update — multiplied by the lr inside optax)
+    clip_norm = opts.pop("clip_norm", None)
+    clip_value = opts.pop("clip_value", None)
+    weight_decay = float(opts.pop("weight_decay", 0.0) or 0.0)
 
     base = _build_base_optimizer(optimizer_name, lr, opts)
+    if weight_decay > 0.0:
+        # DECOUPLED decay (Loshchilov & Hutter): -lr*wd*param added to the
+        # final update, OUTSIDE any adaptive preconditioning — chaining
+        # add_decayed_weights before the optimizer would be plain L2 run
+        # through e.g. adam's rescaling, a different (worse) method
+        base = _with_decoupled_decay(base, weight_decay, lr)
+    pre = []
+    if clip_value is not None:
+        pre.append(optax.clip(float(clip_value)))
+    if clip_norm is not None:
+        pre.append(optax.clip_by_global_norm(float(clip_norm)))
+    if pre:
+        base = optax.chain(*pre, base)
     if accum > 1:
         # gradient accumulation: optax.MultiSteps applies the update every
         # `accum` mini-steps with the averaged gradient — large effective
@@ -225,6 +244,25 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
         base = optax.chain(base, optax.scale_by_schedule(
             build_schedule(schedule)))
     return base
+
+
+def _with_decoupled_decay(inner: optax.GradientTransformation,
+                          weight_decay: float,
+                          lr: float) -> optax.GradientTransformation:
+    """Add ``-lr * weight_decay * param`` to the inner update (AdamW-style
+    decoupled decay, valid for any base optimizer). Requires ``params`` at
+    update time — every train step in this framework passes them."""
+    def init(params):
+        return inner.init(params)
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("weight_decay needs params at update time")
+        u, s = inner.update(updates, state, params)
+        u = jax.tree.map(lambda du, p: du - lr * weight_decay * p, u, params)
+        return u, s
+
+    return optax.GradientTransformation(init, update)
 
 
 def build_schedule(cfg) -> optax.Schedule:

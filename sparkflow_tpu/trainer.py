@@ -368,11 +368,31 @@ class Trainer:
                 infer_params=pspecs is not None)
         epoch_fn = self._epoch_cache[cache_key]
 
-        while True:
+        from .utils.preempt import NullGuard, PreemptionGuard
+        guard = PreemptionGuard() if ckpt_mgr is not None else NullGuard()
+        preempted = False
+        with guard:
+          while True:
             try:
                 it = 0
                 for _round in range(self.partition_shuffles):
                     for _epoch in range(self.iters):
+                        if guard.requested:
+                            # preemption (SIGTERM): save and stop cleanly;
+                            # the next fit on this checkpoint_dir resumes
+                            # here. max(it, start_epoch): during the resume
+                            # skip phase `it` is behind the restored state —
+                            # labeling below start_epoch would regress the
+                            # checkpoint
+                            at = max(it, start_epoch)
+                            ckpt_mgr.save(at, {"params": params,
+                                               "opt_state": opt_state,
+                                               "epoch": np.int64(at),
+                                               "rng": np.asarray(rng)})
+                            logger.warning(
+                                "preempted: checkpoint saved at epoch %d", at)
+                            preempted = True
+                            break
                         it += 1
                         if it <= start_epoch:
                             # the restored rng was saved AFTER these epochs'
@@ -418,6 +438,8 @@ class Trainer:
                                                "opt_state": opt_state,
                                                "epoch": np.int64(it),
                                                "rng": np.asarray(rng)})
+                    if preempted:
+                        break
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -533,55 +555,89 @@ class Trainer:
         it_count = start_step
         t0 = time.perf_counter()
         dummy_y = np.zeros((bs, 1), np.float32)
-        for epoch in range(max(1, epochs)):
-            it = iter(factory() if factory else row_iterator)
-            try:
-                first = next(it)
-            except StopIteration:
-                raise ValueError("no training data")
-            feat0 = vector_to_array(first[0] if supervised else first)
-            row_dim = int(feat0.shape[0])
-            if supervised:
-                lbl0 = first[1]
-                label_dim = (1 if isinstance(lbl0, (int, float))
-                             else len(vector_to_array(lbl0)))
-            else:
-                label_dim = 0
-
-            q = BatchQueue(bs, row_dim, label_dim, capacity=queue_capacity,
-                           shuffle=self.shuffle_per_iter,
-                           seed=self.seed + epoch)
-            feeder = feed_from_iterator(q, _it.chain([first], it), supervised,
-                                        chunk)
-            # NOTE on overlap: the step dispatch is async (JAX enqueues the
-            # computation and the arg transfers), so the device runs batch N
-            # while this loop pops/assembles batch N+1 — an explicit
-            # device_put lookahead would only delay step N's dispatch behind
-            # the (possibly slow) pop of N+1
-            try:
-                for x, y, mask, n_real in q:
-                    rng, srng = jax.random.split(rng)
-                    params, opt_state, loss = step(params, opt_state, x,
-                                                   y if supervised else dummy_y,
-                                                   mask, srng)
-                    losses.append(loss)
-                    seen += n_real
-                    it_count += 1
-                    if self.loss_callback is not None:
-                        self.loss_callback(float(loss), it_count, 0)
-                    if (ckpt_mgr is not None and self.checkpoint_every > 0
-                            and it_count % self.checkpoint_every == 0):
+        from .utils.preempt import NullGuard, PreemptionGuard
+        stream_guard = (PreemptionGuard() if ckpt_mgr is not None
+                        else NullGuard())
+        with stream_guard:
+            for epoch in range(max(1, epochs)):
+                if stream_guard.requested:
+                    # signal landed between epochs (feeder teardown /
+                    # iterator setup window): persist before stopping, same
+                    # contract as the in-loop check
+                    if ckpt_mgr is not None:
                         ckpt_mgr.save(it_count,
                                       {"params": params,
                                        "opt_state": opt_state,
                                        "epoch": np.int64(it_count),
                                        "rng": np.asarray(rng)})
-                feeder.join()
-            finally:
-                # always tear the queue down (drains and unblocks the feeder);
-                # without this a failing step would leak the native ring and
-                # leave the producer thread blocked forever
-                q.close()
+                        logger.warning("preempted: checkpoint saved at "
+                                       "stream step %d", it_count)
+                    break
+                it = iter(factory() if factory else row_iterator)
+                try:
+                    first = next(it)
+                except StopIteration:
+                    raise ValueError("no training data")
+                feat0 = vector_to_array(first[0] if supervised else first)
+                row_dim = int(feat0.shape[0])
+                if supervised:
+                    lbl0 = first[1]
+                    label_dim = (1 if isinstance(lbl0, (int, float))
+                                 else len(vector_to_array(lbl0)))
+                else:
+                    label_dim = 0
+
+                q = BatchQueue(bs, row_dim, label_dim, capacity=queue_capacity,
+                               shuffle=self.shuffle_per_iter,
+                               seed=self.seed + epoch)
+                feeder = feed_from_iterator(q, _it.chain([first], it), supervised,
+                                            chunk)
+                # NOTE on overlap: the step dispatch is async (JAX enqueues the
+                # computation and the arg transfers), so the device runs batch N
+                # while this loop pops/assembles batch N+1 — an explicit
+                # device_put lookahead would only delay step N's dispatch behind
+                # the (possibly slow) pop of N+1
+                try:
+                    for x, y, mask, n_real in q:
+                        if stream_guard.requested:
+                            # preemption: persist and stop; the stream can't
+                            # rewind, so unconsumed rows are not replayed (the
+                            # caller's iterator factory re-pulls the source)
+                            if ckpt_mgr is not None:
+                                ckpt_mgr.save(it_count,
+                                              {"params": params,
+                                               "opt_state": opt_state,
+                                               "epoch": np.int64(it_count),
+                                               "rng": np.asarray(rng)})
+                            logger.warning("preempted: stopping stream at step "
+                                           "%d", it_count)
+                            # unblock the producer BEFORE feeder.join(): it
+                            # may be mid-push into a full queue (close is
+                            # idempotent; the finally re-calls it harmlessly)
+                            q.close()
+                            break
+                        rng, srng = jax.random.split(rng)
+                        params, opt_state, loss = step(params, opt_state, x,
+                                                       y if supervised else dummy_y,
+                                                       mask, srng)
+                        losses.append(loss)
+                        seen += n_real
+                        it_count += 1
+                        if self.loss_callback is not None:
+                            self.loss_callback(float(loss), it_count, 0)
+                        if (ckpt_mgr is not None and self.checkpoint_every > 0
+                                and it_count % self.checkpoint_every == 0):
+                            ckpt_mgr.save(it_count,
+                                          {"params": params,
+                                           "opt_state": opt_state,
+                                           "epoch": np.int64(it_count),
+                                           "rng": np.asarray(rng)})
+                    feeder.join()
+                finally:
+                    # always tear the queue down (drains and unblocks the feeder);
+                    # without this a failing step would leak the native ring and
+                    # leave the producer thread blocked forever
+                    q.close()
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         self.params = params

@@ -66,3 +66,25 @@ def ensure_live_backend(timeout_s: int = 120, retries: int = 1,
 
     float(jax.jit(lambda x: x.sum())(jnp.ones((2,))))
     return True
+
+
+def enable_compilation_cache(path: str = None) -> str:
+    """Turn on JAX's persistent XLA compilation cache.
+
+    First compile of a big program on TPU costs 20-40s; the cache makes every
+    later process reuse it. Default location ~/.cache/sparkflow_tpu/xla
+    (override with ``path`` or ``SPARKFLOW_COMPILATION_CACHE``). Safe to call
+    on any backend; returns the directory in use. Driven by ``bench.py`` and
+    the examples; library code never enables it implicitly.
+    """
+    path = (path or os.environ.get("SPARKFLOW_COMPILATION_CACHE")
+            or os.path.expanduser("~/.cache/sparkflow_tpu/xla"))
+    os.makedirs(path, exist_ok=True)
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything (default only caches compilations > 1s)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # pragma: no cover - older jax without the knobs
+        pass
+    return path

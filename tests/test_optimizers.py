@@ -185,3 +185,71 @@ def test_schedule_string_shorthand_and_bad_spec():
     assert abs(float(s(0)) - 1.0) < 1e-6
     with pytest.raises(ValueError, match="schedule spec"):
         build_schedule(42)
+
+
+def test_clip_norm_and_value():
+    """clip_value caps elements; clip_norm rescales by global norm — both
+    upgrade keys apply to the RAW gradient before the optimizer."""
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.optimizers import build_optimizer
+
+    p = {"w": jnp.zeros(3)}
+    g = {"w": jnp.array([3.0, 4.0, 0.0])}   # global norm 5
+
+    opt = build_optimizer("gradient_descent", 1.0, {"clip_norm": 1.0})
+    u, _ = opt.update(g, opt.init(p), p)
+    np.testing.assert_allclose(np.asarray(u["w"]), [-0.6, -0.8, 0.0],
+                               atol=1e-6)
+
+    opt = build_optimizer("gradient_descent", 1.0, {"clip_value": 2.0})
+    u, _ = opt.update(g, opt.init(p), p)
+    np.testing.assert_allclose(np.asarray(u["w"]), [-2.0, -2.0, 0.0],
+                               atol=1e-6)
+
+
+def test_weight_decay_is_decoupled():
+    """The decay term must NOT pass through adam's preconditioning: with
+    zero gradient, the update is exactly -lr*wd*param for ANY param scale
+    (coupled L2 through adam would normalize it to ~-lr*sign(param))."""
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.optimizers import build_optimizer
+
+    lr, wd = 0.1, 0.01
+    opt = build_optimizer("adam", lr, {"weight_decay": wd})
+    p = {"w": jnp.array([100.0, 1.0, -50.0])}
+    st = opt.init(p)
+    g = {"w": jnp.zeros(3)}
+    u, st = opt.update(g, st, p)
+    np.testing.assert_allclose(np.asarray(u["w"]),
+                               -lr * wd * np.array([100.0, 1.0, -50.0]),
+                               atol=1e-6)
+
+
+def test_weight_decay_trains_toward_smaller_norms():
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.trainer import Trainer
+    import sparkflow_tpu.nn as nn
+
+    def model():
+        x = nn.placeholder([None, 4], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        out = nn.dense(x, 1, activation="sigmoid", name="out")
+        nn.log_loss(y, out)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 4).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    norms = {}
+    for wd in (0.0, 0.3):
+        tr = Trainer(build_graph(model), "x:0", "y:0", optimizer="adam",
+                     optimizer_options={"learning_rate": 0.05,
+                                        "weight_decay": wd},
+                     iters=30, mini_batch_size=32)
+        res = tr.fit(X, Y)
+        flat = np.concatenate([np.ravel(v) for layer in res.params.values()
+                               for v in layer.values()])
+        norms[wd] = float(np.linalg.norm(flat))
+        assert res.losses[-1] < res.losses[0]
+    assert norms[0.3] < norms[0.0]
