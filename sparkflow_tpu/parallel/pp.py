@@ -8,7 +8,7 @@ across them. Differentiation flows through the collective (ppermute transposes
 to the reverse permute), so this is a complete train step, not a forward-only
 demo.
 
-Two schedules share the layout and numerics:
+Three schedules share the layout and numerics:
 
 - ``'gpipe'`` (default): the overlapped fill-drain schedule. Every tick, ALL
   stages compute concurrently — stage ``s`` works on microbatch ``t - s`` —
@@ -19,6 +19,16 @@ Two schedules share the layout and numerics:
   Autodiff reverses the schedule tick-by-tick (ppermute transposes to the
   reverse ring), giving the overlapped backward for free; per-tick
   ``jax.checkpoint`` keeps activation memory at stage boundaries.
+- ``'1f1b'``: one-forward-one-backward (PipeDream-flush / Megatron
+  non-interleaved). The schedule is SIMULATED in numpy at trace time
+  (P, M are static) into per-tick op tables; the compiled step is a single
+  ``lax.scan`` whose tick does the table's op — a hand-scheduled backward
+  via ``jax.vjp`` per microbatch with stage-input recompute, NOT autodiff
+  of the whole schedule. Peak activation memory is **P microbatch inputs**
+  per stage (the 1F1B bound) vs the fill-drain schedule's ``M + P - 1``
+  saved boundary activations; serial span is ``~2M + 2P - 3`` combined
+  fwd+bwd stage-times (GPipe's combined span is the same asymptotically —
+  1F1B's win is memory, not bubble).
 - ``'sequential'``: the round-1 schedule (one stage live per tick), kept as
   the numerics cross-check baseline.
 """
@@ -82,6 +92,95 @@ def pp_pspecs(pp_params):
     return {"stages": stages, "shared": shared}
 
 
+_OP_NONE, _OP_FWD, _OP_BWD = 0, 1, 2
+
+
+def _simulate_1f1b(P: int, M: int):
+    """Tick-by-tick 1F1B schedule tables (pure python; P, M static).
+
+    Greedy rule per stage: backward when a cotangent is ready and either the
+    in-flight limit ``P - s`` is hit or no forward is possible; otherwise
+    forward when an activation is ready. Yields the classic warmup /
+    steady-1F1B / cooldown shape. Returns:
+
+    - ``ops[t, s]``   — executed op (NONE/FWD/BWD); the LAST stage's FWD is
+      rewritten to NONE (its input is already stored by the arrival write,
+      and its BWD tick recomputes forward through the head anyway)
+    - ``mbs[t, s]``   — microbatch index of the op
+    - ``arrf[t, s]``  — 1 when a forward activation arrives at stage s this
+      tick (stage s-1 ran FWD at t-1); ``arrm[t, s]`` its microbatch.
+
+    Invariants (asserted): per-stage live-slot window never exceeds P and
+    in-window microbatches stay distinct mod P — so one ``[P, ...]`` ring
+    buffer keyed ``m % P`` is both the arrival queue and the bwd input store.
+    Cotangents always arrive exactly on their consumption tick (bwd has
+    priority), so they need no buffer at all.
+    """
+    ops, mbs = [], []
+    fwd_done = [0] * P
+    bwd_done = [0] * P
+    act_ready = [dict() for _ in range(P)]
+    cot_ready = [dict() for _ in range(P)]
+    for m in range(M):
+        act_ready[0][m] = 0
+    t = 0
+    while any(b < M for b in bwd_done):
+        if t > 4 * (M + P) + 16:
+            raise AssertionError("1f1b schedule failed to converge")
+        row_op, row_mb = [_OP_NONE] * P, [0] * P
+        for s in range(P):
+            in_flight = fwd_done[s] - bwd_done[s]
+            m_b, m_f = bwd_done[s], fwd_done[s]
+            can_bwd = m_b < M and cot_ready[s].get(m_b, 1 << 30) <= t
+            can_fwd = m_f < M and act_ready[s].get(m_f, 1 << 30) <= t
+            if can_bwd and (in_flight >= P - s or not can_fwd):
+                row_op[s], row_mb[s] = _OP_BWD, m_b
+            elif can_fwd and in_flight < P - s:
+                row_op[s], row_mb[s] = _OP_FWD, m_f
+        for s in range(P):
+            if row_op[s] == _OP_FWD:
+                m = row_mb[s]
+                fwd_done[s] += 1
+                if s + 1 < P:
+                    act_ready[s + 1][m] = t + 1
+                else:
+                    cot_ready[s][m] = t + 1
+            elif row_op[s] == _OP_BWD:
+                m = row_mb[s]
+                bwd_done[s] += 1
+                if s - 1 >= 0:
+                    cot_ready[s - 1][m] = t + 1
+        ops.append(row_op)
+        mbs.append(row_mb)
+        t += 1
+    ops = np.array(ops, np.int32)
+    mbs = np.array(mbs, np.int32)
+    T = ops.shape[0]
+    arrf = np.zeros((T, P), np.int32)
+    arrm = np.zeros((T, P), np.int32)
+    for tt in range(1, T):
+        for s in range(1, P):
+            if ops[tt - 1, s - 1] == _OP_FWD:
+                arrf[tt, s] = 1
+                arrm[tt, s] = mbs[tt - 1, s - 1]
+    # check the ring-buffer invariants (see docstring)
+    for s in range(1, P):
+        live = set()
+        for tt in range(T):
+            if arrf[tt, s]:
+                live.add(int(arrm[tt, s]))
+            if ops[tt, s] == _OP_BWD:
+                live.discard(int(mbs[tt, s]))
+            if len(live) > 1:
+                ms = sorted(live)
+                assert len(live) <= P and ms[-1] - ms[0] < P, (s, tt, ms)
+    # last stage executes nothing at its FWD ticks (timing only — see doc)
+    ops_exec = ops.copy()
+    ops_exec[:, P - 1] = np.where(ops_exec[:, P - 1] == _OP_FWD, _OP_NONE,
+                                  ops_exec[:, P - 1])
+    return ops_exec, mbs, arrf, arrm
+
+
 def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
                        pp_axis: str = "pp", schedule: str = "gpipe",
                        dp_axis: str = "dp", task: str = "classifier"):
@@ -103,8 +202,12 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
     ``schedule_ticks``: the number of serial stage-computations in its
     forward sweep.
     """
-    if schedule not in ("gpipe", "sequential"):
+    if schedule not in ("gpipe", "1f1b", "sequential"):
         raise ValueError(f"unknown pp schedule {schedule!r}")
+    if schedule == "1f1b" and mesh.shape[pp_axis] < 2:
+        # the last-stage arrival-store optimization leaves a 1-stage table
+        # with no forward ops at all — a degenerate pipeline anyway
+        raise ValueError("schedule='1f1b' needs a pp axis of size >= 2")
     if task not in ("classifier", "lm"):
         raise ValueError(f"unknown pp task {task!r}")
     has_dp = dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1
@@ -197,6 +300,108 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         # psums the forward value for reporting only.
         return loss_acc / M
 
+    # ---- 1f1b: table-driven one-forward-one-backward (see module doc) -----
+
+    if schedule == "1f1b":
+        _ops_np, _mbs_np, _arrf_np, _arrm_np = _simulate_1f1b(n_stages, M)
+        _T_1f1b = _ops_np.shape[0]
+        ring_back = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def f1b_grads_and_loss(pp_params, ids, y, rng):
+        """Hand-scheduled 1F1B step body (inside shard_map). Returns LOCAL
+        (grads, loss_sum) — the caller does the pp/dp reductions."""
+        s = jax.lax.axis_index(pp_axis)
+        shared = pp_params["shared"]
+        my_blocks = jax.tree.map(lambda a: a[0], pp_params["stages"])
+        ids = ids.astype(jnp.int32)
+        b, seq = ids.shape
+        mb = b // M
+        dt = model.compute_dtype or jnp.float32
+        zeros_act = jnp.zeros((mb, seq, model.hidden), dt)
+        zero_dgr = jax.tree.map(jnp.zeros_like, pp_params)
+        OPS, MBS = jnp.asarray(_ops_np), jnp.asarray(_mbs_np)
+        ARRF, ARRM = jnp.asarray(_arrf_np), jnp.asarray(_arrm_np)
+
+        def _rng_for(m):
+            # fwd send and bwd recompute fold identically -> same dropout
+            return jax.random.fold_in(rng, m * n_stages + s)
+
+        def tick(carry, t):
+            xbuf, send_f, send_b, gacc, lacc = carry
+            x_arr = jax.lax.ppermute(send_f, pp_axis, ring)
+            g_arr = jax.lax.ppermute(send_b, pp_axis, ring_back)
+            op = OPS[t][s]
+            m = MBS[t][s]
+            # arrival: stash the incoming activation in its ring slot (the
+            # same buffer the bwd recompute reads — invariants in
+            # _simulate_1f1b guarantee no live slot is ever clobbered)
+            slot_in = ARRM[t][s] % n_stages
+            xbuf = jax.lax.cond(
+                ARRF[t][s] == 1,
+                lambda xb: jax.lax.dynamic_update_index_in_dim(
+                    xb, x_arr.astype(dt), slot_in, 0),
+                lambda xb: xb, xbuf)
+
+            def none_br(_):
+                return zeros_act, zeros_act, zero_dgr, jnp.zeros(())
+
+            def fwd_br(_):
+                x0 = embed_micro(shared, ids, m, mb)
+                xs = jax.lax.dynamic_index_in_dim(xbuf, m % n_stages, 0,
+                                                  keepdims=False)
+                x_in = jnp.where(s == 0, x0, xs)
+                out = stage_apply(my_blocks, x_in, _rng_for(m))
+                return out, zeros_act, zero_dgr, jnp.zeros(())
+
+            def bwd_br(_):
+                xs = jax.lax.dynamic_index_in_dim(xbuf, m % n_stages, 0,
+                                                  keepdims=False)
+                rngm = _rng_for(m)
+
+                def last_br(_):
+                    def f(blocks, sh, x):
+                        return head_loss(sh, stage_apply(blocks, x, rngm),
+                                         ids, y, m, mb)
+                    lval, vjp = jax.vjp(f, my_blocks, shared, xs)
+                    db, dsh, dx = vjp(jnp.ones(()))
+                    return db, dsh, dx.astype(dt), lval
+
+                def first_br(_):
+                    def f(blocks, sh):
+                        return stage_apply(
+                            blocks, embed_micro(sh, ids, m, mb), rngm)
+                    out, vjp = jax.vjp(f, my_blocks, shared)
+                    db, dsh = vjp(g_arr.astype(out.dtype))
+                    return db, dsh, zeros_act, jnp.zeros(())
+
+                def mid_br(_):
+                    def f(blocks, x):
+                        return stage_apply(blocks, x, rngm)
+                    out, vjp = jax.vjp(f, my_blocks, xs)
+                    db, dx = vjp(g_arr.astype(out.dtype))
+                    dsh = jax.tree.map(jnp.zeros_like, shared)
+                    return db, dsh, dx.astype(dt), jnp.zeros(())
+
+                db, dsh, dx, lval = jax.lax.cond(
+                    s == n_stages - 1, last_br,
+                    lambda o: jax.lax.cond(s == 0, first_br, mid_br, o),
+                    None)
+                dgr = {"stages": jax.tree.map(lambda g: g[None], db),
+                       "shared": dsh}
+                return zeros_act, dx, dgr, lval
+
+            send_f_new, send_b_new, dgr, dl = jax.lax.switch(
+                op, [none_br, fwd_br, bwd_br], None)
+            gacc = jax.tree.map(jnp.add, gacc, dgr)
+            return (xbuf, send_f_new, send_b_new, gacc, dl + lacc), None
+
+        xbuf0 = jnp.zeros((n_stages, mb, seq, model.hidden), dt)
+        carry0 = (xbuf0, zeros_act, zeros_act, zero_dgr, jnp.zeros(()))
+        (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(_T_1f1b))
+        grads = jax.tree.map(lambda g: g / M, grads)
+        return grads, loss_sum / M
+
     # ---- sequential: one stage live per tick (round-1 baseline) -----------
 
     def forward_one(pp_params, ids, y, rng):
@@ -245,6 +450,9 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
             loss, grads = jax.value_and_grad(gpipe_loss, argnums=0)(
                 pp_params, ids, y, rng)
             loss = jax.lax.psum(loss, pp_axis)  # reporting only
+        elif schedule == "1f1b":
+            grads, loss = f1b_grads_and_loss(pp_params, ids, y, rng)
+            loss = jax.lax.psum(loss, pp_axis)  # nonzero on last stage only
         else:
             # per-microbatch value_and_grad accumulation: only one
             # microbatch's activations are ever live during backward
@@ -281,6 +489,8 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
     # serial forward span in stage-times: the schedule's defining number
+    # (for 1f1b the table length counts COMBINED fwd+bwd compute slots)
     jitted.schedule_ticks = (M + n_stages - 1 if schedule == "gpipe"
+                             else _T_1f1b if schedule == "1f1b"
                              else M * n_stages)
     return jitted

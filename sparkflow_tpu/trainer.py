@@ -50,6 +50,14 @@ from .optimizers import build_optimizer
 logger = logging.getLogger("sparkflow_tpu")
 
 
+
+def _ckpt_state(params, opt_state, step, rng):
+    """The checkpoint payload schema — single source of truth for every
+    save/restore site in fit and fit_stream."""
+    return {"params": params, "opt_state": opt_state,
+            "epoch": np.int64(step), "rng": np.asarray(rng)}
+
+
 class TrainResult:
     """Outcome of a fit: final params + per-epoch mean losses."""
 
@@ -298,10 +306,8 @@ class Trainer:
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
             # host-side structural template, captured BEFORE any donation can
             # invalidate device buffers (restore-after-failure needs it)
-            ckpt_like = jax.tree.map(np.asarray,
-                                     {"params": params, "opt_state": opt_state,
-                                      "epoch": np.int64(0),
-                                      "rng": np.asarray(rng)})
+            ckpt_like = jax.tree.map(
+                np.asarray, _ckpt_state(params, opt_state, 0, rng))
             state = ckpt_mgr.restore(like=ckpt_like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
@@ -385,10 +391,8 @@ class Trainer:
                             # labeling below start_epoch would regress the
                             # checkpoint
                             at = max(it, start_epoch)
-                            ckpt_mgr.save(at, {"params": params,
-                                               "opt_state": opt_state,
-                                               "epoch": np.int64(at),
-                                               "rng": np.asarray(rng)})
+                            ckpt_mgr.save(
+                                at, _ckpt_state(params, opt_state, at, rng))
                             logger.warning(
                                 "preempted: checkpoint saved at epoch %d", at)
                             preempted = True
@@ -434,10 +438,8 @@ class Trainer:
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and (it % self.checkpoint_every == 0
                                      or it == total_epochs)):
-                            ckpt_mgr.save(it, {"params": params,
-                                               "opt_state": opt_state,
-                                               "epoch": np.int64(it),
-                                               "rng": np.asarray(rng)})
+                            ckpt_mgr.save(
+                                it, _ckpt_state(params, opt_state, it, rng))
                     if preempted:
                         break
                 break
@@ -535,10 +537,8 @@ class Trainer:
             # rewind, so previously consumed rows are not replayed)
             from .checkpoint import CheckpointManager
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
-            like = jax.tree.map(np.asarray,
-                                {"params": params, "opt_state": opt_state,
-                                 "epoch": np.int64(0),
-                                 "rng": np.asarray(rng)})
+            like = jax.tree.map(
+                np.asarray, _ckpt_state(params, opt_state, 0, rng))
             state = ckpt_mgr.restore(like=like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
@@ -558,18 +558,16 @@ class Trainer:
         from .utils.preempt import NullGuard, PreemptionGuard
         stream_guard = (PreemptionGuard() if ckpt_mgr is not None
                         else NullGuard())
+        preempt_saved = False
         with stream_guard:
             for epoch in range(max(1, epochs)):
                 if stream_guard.requested:
                     # signal landed between epochs (feeder teardown /
                     # iterator setup window): persist before stopping, same
                     # contract as the in-loop check
-                    if ckpt_mgr is not None:
-                        ckpt_mgr.save(it_count,
-                                      {"params": params,
-                                       "opt_state": opt_state,
-                                       "epoch": np.int64(it_count),
-                                       "rng": np.asarray(rng)})
+                    if ckpt_mgr is not None and not preempt_saved:
+                        ckpt_mgr.save(it_count, _ckpt_state(
+                            params, opt_state, it_count, rng))
                         logger.warning("preempted: checkpoint saved at "
                                        "stream step %d", it_count)
                     break
@@ -604,11 +602,9 @@ class Trainer:
                             # rewind, so unconsumed rows are not replayed (the
                             # caller's iterator factory re-pulls the source)
                             if ckpt_mgr is not None:
-                                ckpt_mgr.save(it_count,
-                                              {"params": params,
-                                               "opt_state": opt_state,
-                                               "epoch": np.int64(it_count),
-                                               "rng": np.asarray(rng)})
+                                ckpt_mgr.save(it_count, _ckpt_state(
+                                    params, opt_state, it_count, rng))
+                                preempt_saved = True
                             logger.warning("preempted: stopping stream at step "
                                            "%d", it_count)
                             # unblock the producer BEFORE feeder.join(): it
@@ -627,11 +623,8 @@ class Trainer:
                             self.loss_callback(float(loss), it_count, 0)
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and it_count % self.checkpoint_every == 0):
-                            ckpt_mgr.save(it_count,
-                                          {"params": params,
-                                           "opt_state": opt_state,
-                                           "epoch": np.int64(it_count),
-                                           "rng": np.asarray(rng)})
+                            ckpt_mgr.save(it_count, _ckpt_state(
+                                params, opt_state, it_count, rng))
                     feeder.join()
                 finally:
                     # always tear the queue down (drains and unblocks the feeder);

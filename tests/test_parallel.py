@@ -72,7 +72,7 @@ def test_pp_gpipe_matches_sequential_schedule(pp_setup):
     y = jnp.asarray(np.eye(3)[rs.randint(0, 3, 8)], jnp.float32)
 
     results = {}
-    for sched in ("gpipe", "sequential"):
+    for sched in ("gpipe", "1f1b", "sequential"):
         pp = shard_params(split_stage_params(m, params, 4), mesh,
                           pp_pspecs(split_stage_params(m, params, 4)))
         step = make_pp_train_step(m, opt, mesh, n_microbatches=4,
@@ -80,16 +80,45 @@ def test_pp_gpipe_matches_sequential_schedule(pp_setup):
         p2, _, loss = step(pp, opt.init(pp), ids, y, jax.random.PRNGKey(7))
         results[sched] = (float(loss), merge_stage_params(m, p2))
 
-    assert results["gpipe"][0] == pytest.approx(results["sequential"][0],
-                                                rel=1e-5)
-    for a, b in zip(jax.tree.leaves(results["gpipe"][1]),
-                    jax.tree.leaves(results["sequential"][1])):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5)
+    for sched in ("gpipe", "1f1b"):
+        assert results[sched][0] == pytest.approx(results["sequential"][0],
+                                                  rel=1e-5), sched
+        for a, b in zip(jax.tree.leaves(results[sched][1]),
+                        jax.tree.leaves(results["sequential"][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=sched)
     # schedule property: 4 microbatches over 4 stages
     g = make_pp_train_step(m, opt, mesh, n_microbatches=4, schedule="gpipe")
+    f = make_pp_train_step(m, opt, mesh, n_microbatches=4, schedule="1f1b")
     s = make_pp_train_step(m, opt, mesh, n_microbatches=4, schedule="sequential")
     assert g.schedule_ticks == 7 and s.schedule_ticks == 16
+    # 1f1b table counts COMBINED fwd+bwd slots: ~2M + 2P - 3
+    assert f.schedule_ticks == 14
+
+
+def test_pp_1f1b_schedule_tables():
+    """The simulated schedule has the canonical 1F1B shape: per-stage
+    in-flight peaks at exactly min(M, P - s), every microbatch runs fwd+bwd
+    exactly once per stage, and cotangents arrive on their consumption
+    tick."""
+    from sparkflow_tpu.parallel.pp import (_OP_BWD, _OP_FWD, _simulate_1f1b)
+
+    for P, M in ((2, 2), (4, 4), (4, 8), (8, 16), (3, 5)):
+        ops, mbs, arrf, arrm = _simulate_1f1b(P, M)
+        for s in range(P):
+            f = b = peak = 0
+            for t in range(ops.shape[0]):
+                if ops[t, s] == _OP_FWD:
+                    f += 1
+                if ops[t, s] == _OP_BWD:
+                    b += 1
+                peak = max(peak, f - b)
+            # last stage's FWD ops are rewritten to NONE (arrival-stored)
+            assert b == M, (P, M, s)
+            if s < P - 1:
+                assert f == M, (P, M, s)
+                assert peak == min(M, P - s), (P, M, s, peak)
 
 
 def test_moe_ep_sharding_matches_replicated():
@@ -391,7 +420,8 @@ def test_pp_composes_with_dp(pp_setup):
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_pp_lm_task_matches_single_device():
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_pp_lm_task_matches_single_device(sched):
     """Pipeline-parallel causal LM (task='lm'): loss and the SGD update must
     match the single-device transformer_lm on the same batch."""
     import optax
@@ -404,7 +434,8 @@ def test_pp_lm_task_matches_single_device():
     pp = shard_params(split_stage_params(m, params, 4), mesh,
                       pp_pspecs(split_stage_params(m, params, 4)))
     opt = build_optimizer("gradient_descent", 0.1, None)
-    step = make_pp_train_step(m, opt, mesh, n_microbatches=2, task="lm")
+    step = make_pp_train_step(m, opt, mesh, n_microbatches=2, task="lm",
+                              schedule=sched)
     rs = np.random.RandomState(3)
     ids = jnp.asarray(rs.randint(0, 40, (8, 16)), jnp.int32)
     mask = jnp.ones((8, 16), jnp.float32)
