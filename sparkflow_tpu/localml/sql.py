@@ -11,6 +11,8 @@ the real parallelism substrate underneath.
 from __future__ import annotations
 
 import csv as _csv
+import json as _json
+import os as _os
 import random as _random
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
@@ -204,8 +206,189 @@ class DataFrame:
         for r in self._rows[:n]:
             print(" | ".join(str(r[c]) for c in self.columns))
 
+    def filter(self, pred) -> "DataFrame":
+        """localml: ``pred`` is a callable Row -> bool (no Column exprs)."""
+        if not callable(pred):
+            raise TypeError("localml filter() takes a callable Row -> bool")
+        rows = [r for r in self._rows if pred(r)]
+        return DataFrame(rows, self.columns, self.num_partitions)
+
+    where = filter
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._rows[:n], self.columns, self.num_partitions)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if list(other.columns) != list(self.columns):
+            raise ValueError(f"union: column mismatch {self.columns} vs "
+                             f"{other.columns}")
+        return DataFrame(self._rows + other.collect(), self.columns,
+                         self.num_partitions)
+
+    def sample(self, withReplacement=None, fraction=None, seed=None
+               ) -> "DataFrame":
+        # pyspark also allows sample(fraction) / sample(fraction, seed)
+        if isinstance(withReplacement, float):
+            withReplacement, fraction, seed = False, withReplacement, fraction
+        rng = _random.Random(seed)
+        if withReplacement:
+            k = int(round(len(self._rows) * float(fraction)))
+            rows = [rng.choice(self._rows) for _ in range(k)] if self._rows else []
+        else:
+            rows = [r for r in self._rows if rng.random() < float(fraction)]
+        return DataFrame(rows, self.columns, self.num_partitions)
+
+    def randomSplit(self, weights, seed=None) -> List["DataFrame"]:
+        total = float(sum(weights))
+        rng = _random.Random(seed)
+        rows = list(self._rows)
+        rng.shuffle(rows)
+        out, start = [], 0
+        bounds = []
+        acc = 0.0
+        for w in weights[:-1]:
+            acc += w / total
+            bounds.append(int(round(acc * len(rows))))
+        bounds.append(len(rows))
+        for b in bounds:
+            out.append(DataFrame(rows[start:b], self.columns,
+                                 self.num_partitions))
+            start = b
+        return out
+
+    def dropna(self, subset=None) -> "DataFrame":
+        if isinstance(subset, str):
+            subset = [subset]
+        cols = subset or self.columns
+
+        def ok(r):
+            for c in cols:
+                v = r[c]
+                if v is None:
+                    return False
+                if isinstance(v, float) and v != v:  # NaN
+                    return False
+            return True
+
+        return DataFrame([r for r in self._rows if ok(r)], self.columns,
+                         self.num_partitions)
+
+    def fillna(self, value, subset=None) -> "DataFrame":
+        if isinstance(subset, str):
+            subset = [subset]
+        cols = subset or self.columns
+        # pyspark only fills columns whose type matches the value: numbers
+        # fill numeric columns, strings fill string columns
+        want_str = isinstance(value, str)
+
+        def col_matches(c):
+            for r in self._rows:
+                v = r[c]
+                if v is None or (isinstance(v, float) and v != v):
+                    continue
+                return isinstance(v, str) == want_str
+            return True  # all-null column: fill it
+
+        cols = [c for c in cols if col_matches(c)]
+
+        def fix(r):
+            d = r.asDict()
+            for c in cols:
+                v = d.get(c)
+                if v is None or (isinstance(v, float) and v != v):
+                    d[c] = value
+            return Row(**d)
+
+        return DataFrame([fix(r) for r in self._rows], self.columns,
+                         self.num_partitions)
+
+    def cache(self) -> "DataFrame":
+        return self  # everything is already in memory
+
+    def persist(self, *_a) -> "DataFrame":
+        return self
+
+    def unpersist(self, *_a) -> "DataFrame":
+        return self
+
+    def toPandas(self):
+        import pandas as pd
+        return pd.DataFrame([r.asDict() for r in self._rows],
+                            columns=self.columns)
+
+    @property
+    def write(self) -> "_Writer":
+        return _Writer(self)
+
     def __repr__(self):
         return f"DataFrame[{', '.join(self.columns)}] ({len(self._rows)} rows)"
+
+
+def _vector_to_plain(v):
+    """DenseVector/SparseVector -> list[float] for columnar formats (the
+    JVM VectorUDT has no pyarrow analog; densified on purpose)."""
+    if hasattr(v, "toArray"):
+        return [float(x) for x in v.toArray()]
+    return v
+
+
+def _plain_to_vector(v):
+    """list-of-numbers -> DenseVector on read (the inverse convention)."""
+    if (isinstance(v, list) and v
+            and all(isinstance(x, (int, float)) for x in v)):
+        from .linalg import Vectors
+        return Vectors.dense([float(x) for x in v])
+    return v
+
+
+class _Writer:
+    """``df.write.mode("overwrite").parquet(path)`` / ``.json(path)`` /
+    ``.csv(path)`` — single-file writers for the standalone engine."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+        self._mode = "error"
+
+    def mode(self, m: str) -> "_Writer":
+        self._mode = m
+        return self
+
+    def _should_write(self, path: str) -> bool:
+        if _os.path.exists(path):
+            if self._mode == "overwrite":
+                return True
+            if self._mode == "ignore":
+                return False
+            raise IOError(f"path {path} already exists (mode='error')")
+        return True
+
+    def parquet(self, path: str) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        if not self._should_write(path):
+            return
+        rows = self._df.collect()
+        cols = {c: [_vector_to_plain(r[c]) for r in rows]
+                for c in self._df.columns}
+        pq.write_table(pa.table(cols), path)
+
+    def json(self, path: str) -> None:
+        if not self._should_write(path):
+            return
+        with open(path, "w") as f:
+            for r in self._df.collect():
+                d = {c: _vector_to_plain(r[c]) for c in self._df.columns}
+                f.write(_json.dumps(d) + "\n")
+
+    def csv(self, path: str) -> None:
+        if not self._should_write(path):
+            return
+        with open(path, "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(self._df.columns)
+            for r in self._df.collect():
+                w.writerow([_vector_to_plain(r[c])
+                            for c in self._df.columns])
 
 
 class _CsvReader:
@@ -232,6 +415,34 @@ class _CsvReader:
                 vals = [_parse(v) if infer else v for v in rec]
                 rows.append(Row(**dict(zip(cols, vals))))
         return DataFrame(rows, cols or [], self._session._default_parallelism)
+
+    def parquet(self, path: str) -> DataFrame:
+        import pyarrow.parquet as pq
+        table = pq.read_table(path)
+        cols = table.column_names
+        data = {c: table.column(c).to_pylist() for c in cols}
+        n = table.num_rows
+        rows = [Row(**{c: _plain_to_vector(data[c][i]) for c in cols})
+                for i in range(n)]
+        return DataFrame(rows, cols, self._session._default_parallelism)
+
+    def json(self, path: str) -> DataFrame:
+        """JSON Lines (one object per line), like spark.read.json. Missing
+        keys on a line become None (pyspark fills null)."""
+        dicts, cols = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = {k: _plain_to_vector(v)
+                     for k, v in _json.loads(line).items()}
+                for k in d:
+                    if k not in cols:
+                        cols.append(k)
+                dicts.append(d)
+        rows = [Row(**{c: d.get(c) for c in cols}) for d in dicts]
+        return DataFrame(rows, cols, self._session._default_parallelism)
 
 
 def _parse(s: str):

@@ -168,3 +168,133 @@ def test_text_pipeline_end_to_end(spark):
         loaded = PipelineModel.load(path)
         again = loaded.transform(df).collect()
         assert [r["label"] for r in again] == [r["label"] for r in out]
+
+
+# ---------------------------------------------------------------------------
+# DataFrame widening: filter/limit/union/sample/randomSplit/na + parquet/json
+# ---------------------------------------------------------------------------
+
+def test_dataframe_relational_ops(spark):
+    df = spark.createDataFrame([(i, float(i % 2)) for i in range(10)],
+                               ["i", "label"])
+    assert df.filter(lambda r: r["label"] == 1.0).count() == 5
+    assert df.where(lambda r: r["i"] < 3).count() == 3
+    assert df.limit(4).count() == 4
+    u = df.union(df)
+    assert u.count() == 20
+    with pytest.raises(ValueError, match="column mismatch"):
+        df.union(df.select("i"))
+    s = df.sample(fraction=0.5, seed=0)
+    assert 0 < s.count() < 10
+    a, b = df.randomSplit([0.7, 0.3], seed=1)
+    assert a.count() + b.count() == 10
+    assert set(r["i"] for r in a.collect()).isdisjoint(
+        r["i"] for r in b.collect())
+    assert df.cache() is df
+
+
+def test_dataframe_na_handling(spark):
+    rows = [(1.0, "a"), (float("nan"), "b"), (None, "c"), (4.0, None)]
+    df = spark.createDataFrame(rows, ["v", "s"])
+    assert df.dropna().count() == 1
+    assert df.dropna(subset=["v"]).count() == 2
+    filled = df.fillna(0.0, subset=["v"]).collect()
+    assert [r["v"] for r in filled] == [1.0, 0.0, 0.0, 4.0]
+
+
+def test_parquet_round_trip_with_vectors(spark, tmp_path):
+    rows = [(Vectors.dense([1.0, 2.0]), 0.0), (Vectors.dense([3.0, 4.0]), 1.0)]
+    df = spark.createDataFrame(rows, ["features", "label"])
+    path = str(tmp_path / "data.parquet")
+    df.write.parquet(path)
+    back = spark.read.parquet(path)
+    got = back.collect()
+    assert back.columns == ["features", "label"]
+    # list-of-numbers columns rebuild as DenseVector (documented convention)
+    np.testing.assert_allclose(np.asarray(got[1]["features"].toArray()),
+                               [3.0, 4.0])
+    assert got[0]["label"] == 0.0
+    with pytest.raises(IOError, match="exists"):
+        df.write.parquet(path)
+    df.write.mode("overwrite").parquet(path)  # no error
+
+
+def test_json_lines_round_trip(spark, tmp_path):
+    rows = [(Vectors.dense([1.0]), "x"), (Vectors.dense([2.0]), "y")]
+    df = spark.createDataFrame(rows, ["f", "tag"])
+    path = str(tmp_path / "data.jsonl")
+    df.write.json(path)
+    back = spark.read.json(path)
+    got = back.collect()
+    assert [r["tag"] for r in got] == ["x", "y"]
+    np.testing.assert_allclose(np.asarray(got[0]["f"].toArray()), [1.0])
+
+
+def test_to_pandas(spark):
+    df = spark.createDataFrame([(1, "a"), (2, "b")], ["n", "s"])
+    pdf = df.toPandas()
+    assert list(pdf.columns) == ["n", "s"]
+    assert pdf["n"].tolist() == [1, 2]
+
+
+def test_parquet_feeds_estimator(spark, tmp_path):
+    """parquet -> DataFrame -> SparkAsyncDL: the columnar path trains."""
+    import sparkflow_tpu.nn as nn
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL
+
+    rs = np.random.RandomState(0)
+    rows = [(Vectors.dense(rs.normal(1.0 if i % 2 else -1.0, 1.0, 4)),
+             float(i % 2)) for i in range(120)]
+    spark.createDataFrame(rows, ["features", "label"]) \
+        .write.mode("overwrite").parquet(str(tmp_path / "train.parquet"))
+    df = spark.read.parquet(str(tmp_path / "train.parquet"))
+
+    def m():
+        x = nn.placeholder([None, 4], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        out = nn.dense(x, 1, activation="sigmoid", name="out")
+        nn.log_loss(y, out)
+
+    est = SparkAsyncDL(inputCol="features", tensorflowGraph=build_graph(m),
+                       tfInput="x:0", tfLabel="y:0", labelCol="label",
+                       tfOutput="out:0", iters=30, miniBatchSize=64,
+                       tfOptimizer="adam", tfLearningRate=0.05,
+                       predictionCol="pred")
+    model = est.fit(df)
+    out = model.transform(df).collect()
+    acc = np.mean([(float(r["pred"]) > 0.5) == (r["label"] > 0.5)
+                   for r in out])
+    assert acc > 0.9
+
+
+def test_sample_positional_and_ragged_json(spark, tmp_path):
+    df = spark.createDataFrame([(i,) for i in range(10)], ["i"])
+    s = df.sample(0.5, 42)          # pyspark positional (fraction, seed)
+    assert 0 < s.count() < 10
+    # ragged JSONL: missing keys become None, not KeyError
+    p = str(tmp_path / "ragged.jsonl")
+    with open(p, "w") as f:
+        f.write('{"a": 1, "b": 2}\n{"a": 3}\n')
+    back = spark.read.json(p)
+    assert back.columns == ["a", "b"]
+    assert back.collect()[1]["b"] is None
+    back.show(1)  # no KeyError on display either
+
+
+def test_fillna_type_matched_and_string_subset(spark):
+    df = spark.createDataFrame([(4.0, None), (None, "x")], ["v", "s"])
+    out = df.fillna(0.0).collect()
+    assert out[1]["v"] == 0.0
+    assert out[0]["s"] is None       # numeric fill leaves string column null
+    out2 = df.fillna("?", subset="s").collect()
+    assert out2[0]["s"] == "?"
+    assert df.dropna(subset="v").count() == 1
+
+
+def test_csv_writer_densifies_vectors(spark, tmp_path):
+    df = spark.createDataFrame([(Vectors.dense([1.0, 2.0]),)], ["f"])
+    p = str(tmp_path / "out.csv")
+    df.write.csv(p)
+    text = open(p).read()
+    assert "DenseVector" not in text and "[1.0, 2.0]" in text
