@@ -21,6 +21,8 @@ touches them):
 - ``pipeline``:    ``Pipeline``, ``PipelineModel``
 - ``evaluation``:  ``MulticlassClassificationEvaluator``,
                    ``BinaryClassificationEvaluator``
+- ``tuning``:      ``ParamGridBuilder``, ``CrossValidator``,
+                   ``TrainValidationSplit``
 """
 
 from .param import Param, Params, TypeConverters, keyword_only
@@ -35,6 +37,8 @@ from .feature import (VectorAssembler, OneHotEncoder, Normalizer,
 from .pipeline import Pipeline, PipelineModel
 from .evaluation import (MulticlassClassificationEvaluator,
                          BinaryClassificationEvaluator)
+from .tuning import (ParamGridBuilder, CrossValidator, CrossValidatorModel,
+                     TrainValidationSplit, TrainValidationSplitModel)
 
 __all__ = [
     "Param", "Params", "TypeConverters", "keyword_only",
@@ -47,4 +51,6 @@ __all__ = [
     "MinMaxScalerModel", "Bucketizer",
     "Pipeline", "PipelineModel",
     "MulticlassClassificationEvaluator", "BinaryClassificationEvaluator",
+    "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
+    "TrainValidationSplit", "TrainValidationSplitModel",
 ]

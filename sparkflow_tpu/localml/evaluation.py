@@ -21,6 +21,9 @@ class MulticlassClassificationEvaluator(HasLabelCol, HasPredictionCol):
         kwargs = self._input_kwargs
         self._set(**kwargs)
 
+    def isLargerBetter(self) -> bool:
+        return True  # accuracy / f1 both improve upward
+
     def evaluate(self, dataset) -> float:
         label_col = self.getOrDefault(self.labelCol)
         pred_col = self.getOrDefault(self.predictionCol)
@@ -64,6 +67,9 @@ class BinaryClassificationEvaluator(HasLabelCol, HasPredictionCol):
         self._setDefault(rawPredictionCol="rawPrediction", labelCol="label",
                          metricName="areaUnderROC")
         self._set(**self._input_kwargs)
+
+    def isLargerBetter(self) -> bool:
+        return True  # both AUC metrics improve upward
 
     @staticmethod
     def _score(v) -> float:

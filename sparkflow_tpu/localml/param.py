@@ -229,6 +229,12 @@ class Params(Identifiable):
                                  for p, v in that._defaultParamMap.items()}
         if extra:
             for p, v in extra.items():
+                # pyspark semantics: extras keyed by a Param another object
+                # owns are ignored here (the owning stage applies them —
+                # see Pipeline.copy); string keys always resolve locally
+                if isinstance(p, Param):
+                    if p.parent != that.uid or not that.hasParam(p.name):
+                        continue
                 that._paramMap[that._resolveParam(p)] = v
         return that
 

@@ -31,6 +31,15 @@ class Pipeline(Estimator):
     def setStages(self, stages) -> "Pipeline":
         return self._set(stages=stages)
 
+    def copy(self, extra=None) -> "Pipeline":
+        """Propagate ``extra`` INTO the stages (pyspark behavior) — this is
+        what lets CrossValidator grids target a stage's params."""
+        that = super().copy(extra)
+        stages = self.getStages()
+        if stages:
+            that._set(stages=[s.copy(extra) for s in stages])
+        return that
+
     def _fit(self, dataset) -> "PipelineModel":
         fitted: List[Transformer] = []
         current = dataset

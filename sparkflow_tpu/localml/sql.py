@@ -256,19 +256,24 @@ class DataFrame:
             start = b
         return out
 
-    def dropna(self, subset=None) -> "DataFrame":
+    def dropna(self, how: str = "any", thresh=None, subset=None
+               ) -> "DataFrame":
+        """pyspark signature: how='any'|'all', thresh = min non-null count
+        (overrides how), subset = columns to consider."""
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
         if isinstance(subset, str):
             subset = [subset]
         cols = subset or self.columns
 
+        def is_null(v):
+            return v is None or (isinstance(v, float) and v != v)
+
         def ok(r):
-            for c in cols:
-                v = r[c]
-                if v is None:
-                    return False
-                if isinstance(v, float) and v != v:  # NaN
-                    return False
-            return True
+            non_null = sum(0 if is_null(r[c]) else 1 for c in cols)
+            if thresh is not None:
+                return non_null >= thresh
+            return non_null == len(cols) if how == "any" else non_null > 0
 
         return DataFrame([r for r in self._rows if ok(r)], self.columns,
                          self.num_partitions)
@@ -277,8 +282,9 @@ class DataFrame:
         if isinstance(subset, str):
             subset = [subset]
         cols = subset or self.columns
-        # pyspark only fills columns whose type matches the value: numbers
-        # fill numeric columns, strings fill string columns
+        # pyspark only fills SCALAR columns whose type matches the value:
+        # numbers fill numeric columns, strings fill string columns; vector
+        # or other object columns are never touched
         want_str = isinstance(value, str)
 
         def col_matches(c):
@@ -286,7 +292,10 @@ class DataFrame:
                 v = r[c]
                 if v is None or (isinstance(v, float) and v != v):
                     continue
-                return isinstance(v, str) == want_str
+                if want_str:
+                    return isinstance(v, str)
+                return isinstance(v, (int, float, bool)) \
+                    and not isinstance(v, str)
             return True  # all-null column: fill it
 
         cols = [c for c in cols if col_matches(c)]
@@ -350,6 +359,9 @@ class _Writer:
         self._mode = "error"
 
     def mode(self, m: str) -> "_Writer":
+        if m not in ("error", "errorifexists", "overwrite", "ignore"):
+            raise ValueError(f"unsupported write mode {m!r} (supported: "
+                             f"error, overwrite, ignore)")
         self._mode = m
         return self
 
@@ -359,7 +371,8 @@ class _Writer:
                 return True
             if self._mode == "ignore":
                 return False
-            raise IOError(f"path {path} already exists (mode='error')")
+            raise IOError(f"path {path} already exists "
+                          f"(mode={self._mode!r})")
         return True
 
     def parquet(self, path: str) -> None:

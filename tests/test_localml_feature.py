@@ -298,3 +298,29 @@ def test_csv_writer_densifies_vectors(spark, tmp_path):
     df.write.csv(p)
     text = open(p).read()
     assert "DenseVector" not in text and "[1.0, 2.0]" in text
+
+
+def test_dropna_how_thresh_and_fillna_vector_guard(spark):
+    rows = [(1.0, None), (None, None), (None, "x")]
+    df = spark.createDataFrame(rows, ["v", "s"])
+    assert df.dropna("any").count() == 0
+    assert df.dropna("all").count() == 2
+    assert df.dropna(thresh=1).count() == 2
+    with pytest.raises(ValueError, match="how"):
+        df.dropna("sometimes")
+    # vector columns are never scalar-filled
+    vrows = [(Vectors.dense([1.0]),), (None,)]
+    vdf = spark.createDataFrame(vrows, ["f"])
+    out = vdf.fillna(0.0).collect()
+    assert out[1]["f"] is None  # untouched, not corrupted to 0.0
+
+
+def test_writer_mode_validation(spark, tmp_path):
+    df = spark.createDataFrame([(1,)], ["a"])
+    with pytest.raises(ValueError, match="unsupported write mode"):
+        df.write.mode("append")
+    p = str(tmp_path / "x.json")
+    df.write.json(p)
+    with pytest.raises(IOError, match="mode='error'"):
+        df.write.json(p)
+    df.write.mode("ignore").json(p)  # silently keeps the old file

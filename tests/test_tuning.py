@@ -1,0 +1,189 @@
+"""localml.tuning: ParamGridBuilder / CrossValidator / TrainValidationSplit
+(the pyspark.ml.tuning subset; the reference never built its planned
+hyperparameter search — reference ``README.md:234-236``)."""
+
+import numpy as np
+import pytest
+
+from sparkflow_tpu.localml import (
+    CrossValidator, CrossValidatorModel, LocalSession,
+    MulticlassClassificationEvaluator, ParamGridBuilder,
+    TrainValidationSplit, Vectors)
+from sparkflow_tpu.localml.base import Estimator, Model
+from sparkflow_tpu.localml.param import (HasInputCol, Param, Params,
+                                         TypeConverters, keyword_only)
+from sparkflow_tpu.localml.sql import DataFrame, Row
+
+
+@pytest.fixture(scope="module")
+def spark():
+    return LocalSession.builder.getOrCreate()
+
+
+class _ThresholdModel(Model, HasInputCol):
+    def __init__(self, threshold):
+        super().__init__()
+        self._t = threshold
+
+    def _transform(self, dataset):
+        rows = [Row(**{**r.asDict(),
+                       "prediction": float(r["x"] > self._t)})
+                for r in dataset.collect()]
+        return DataFrame(rows, dataset.columns + ["prediction"],
+                         dataset.num_partitions)
+
+
+class _ThresholdClassifier(Estimator, HasInputCol):
+    """Degenerate estimator: 'fits' nothing, classifies x > threshold.
+    Grid search must recover the threshold that matches the labels."""
+
+    threshold = Param(Params._dummy(), "threshold", "decision threshold",
+                      typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, threshold=0.0):
+        super().__init__()
+        self._setDefault(threshold=0.0)
+        self._set(**self._input_kwargs)
+
+    def _fit(self, dataset):
+        return _ThresholdModel(self.getOrDefault(self.threshold))
+
+
+def _labeled_df(spark, true_threshold=2.0, n=60):
+    rs = np.random.RandomState(0)
+    xs = rs.uniform(0, 4, n)
+    return spark.createDataFrame(
+        [(float(x), float(x > true_threshold)) for x in xs], ["x", "label"])
+
+
+def test_param_grid_builder():
+    e = _ThresholdClassifier()
+    grid = (ParamGridBuilder()
+            .addGrid(e.threshold, [0.5, 1.0, 2.0])
+            .build())
+    assert len(grid) == 3
+    assert sorted(pm[e.threshold] for pm in grid) == [0.5, 1.0, 2.0]
+    # cartesian product over two params
+    e2 = _ThresholdClassifier()
+    grid2 = (ParamGridBuilder()
+             .addGrid(e2.threshold, [0.5, 1.0])
+             .baseOn({e2.inputCol: "x"})
+             .build())
+    assert len(grid2) == 2
+    assert all(pm[e2.inputCol] == "x" for pm in grid2)
+
+
+def test_cross_validator_picks_true_threshold(spark):
+    df = _labeled_df(spark)
+    est = _ThresholdClassifier()
+    grid = ParamGridBuilder().addGrid(est.threshold,
+                                      [0.5, 1.0, 2.0, 3.0]).build()
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid,
+                        evaluator=MulticlassClassificationEvaluator(
+                            metricName="accuracy"),
+                        numFolds=3, seed=7)
+    model = cv.fit(df)
+    assert isinstance(model, CrossValidatorModel)
+    assert len(model.avgMetrics) == 4
+    assert int(np.argmax(model.avgMetrics)) == 2  # threshold=2.0 wins
+    assert model.bestModel._t == 2.0
+    out = model.transform(df)  # CrossValidatorModel delegates to bestModel
+    acc = np.mean([r["prediction"] == r["label"] for r in out.collect()])
+    assert acc == 1.0
+
+
+def test_cross_validator_validation(spark):
+    df = _labeled_df(spark)
+    with pytest.raises(ValueError, match="needs estimator"):
+        CrossValidator().fit(df)
+    est = _ThresholdClassifier()
+    grid = ParamGridBuilder().addGrid(est.threshold, [1.0]).build()
+    with pytest.raises(ValueError, match="numFolds"):
+        CrossValidator(estimator=est, estimatorParamMaps=grid,
+                       evaluator=MulticlassClassificationEvaluator(),
+                       numFolds=1).fit(df)
+
+
+def test_train_validation_split(spark):
+    df = _labeled_df(spark)
+    est = _ThresholdClassifier()
+    grid = ParamGridBuilder().addGrid(est.threshold,
+                                      [0.5, 2.0, 3.5]).build()
+    tvs = TrainValidationSplit(estimator=est, estimatorParamMaps=grid,
+                               evaluator=MulticlassClassificationEvaluator(
+                                   metricName="accuracy"),
+                               trainRatio=0.75, seed=3)
+    model = tvs.fit(df)
+    assert len(model.validationMetrics) == 3
+    assert model.bestModel._t == 2.0
+    with pytest.raises(ValueError, match="trainRatio"):
+        TrainValidationSplit(estimator=est, estimatorParamMaps=grid,
+                             evaluator=MulticlassClassificationEvaluator(),
+                             trainRatio=1.5).fit(df)
+
+
+def test_cross_validator_over_dl_estimator(spark):
+    """Grid search over SparkAsyncDL's learning rate through CrossValidator —
+    the composition the reference called future work."""
+    import sparkflow_tpu.nn as nn
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.tensorflow_async import SparkAsyncDL
+
+    rs = np.random.RandomState(0)
+    rows = [(Vectors.dense(rs.normal(1.2 if i % 2 else -1.2, 1.0, 4)),
+             float(i % 2)) for i in range(80)]
+    df = spark.createDataFrame(rows, ["features", "label"])
+
+    def m():
+        x = nn.placeholder([None, 4], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        out = nn.dense(x, 1, activation="sigmoid", name="out")
+        nn.log_loss(y, out)
+
+    est = SparkAsyncDL(inputCol="features", tensorflowGraph=build_graph(m),
+                       tfInput="x:0", tfLabel="y:0", labelCol="label",
+                       tfOutput="out:0", iters=20, miniBatchSize=32,
+                       tfOptimizer="adam", predictionCol="rawPrediction")
+    # an absurdly small lr cannot separate the data in 20 iters; a sane one can
+    grid = ParamGridBuilder().addGrid(est.tfLearningRate,
+                                      [1e-6, 5e-2]).build()
+    from sparkflow_tpu.localml import BinaryClassificationEvaluator
+    tvs = TrainValidationSplit(estimator=est, estimatorParamMaps=grid,
+                               evaluator=BinaryClassificationEvaluator(
+                                   labelCol="label"),
+                               trainRatio=0.75, seed=0)
+    model = tvs.fit(df)
+    assert model.validationMetrics[1] > model.validationMetrics[0]
+    auc = BinaryClassificationEvaluator(labelCol="label").evaluate(
+        model.transform(df))
+    assert auc > 0.9
+
+
+def test_grid_search_over_pipeline_stage_params(spark):
+    """The standard pyspark pattern: grid keyed by a STAGE's params while
+    tuning the whole Pipeline — Pipeline.copy propagates extras to stages."""
+    from sparkflow_tpu.localml import Pipeline, Tokenizer
+
+    est = _ThresholdClassifier()
+    tok = Tokenizer(inputCol="text", outputCol="words")  # passthrough stage
+    pipe = Pipeline(stages=[tok, est])
+    df = _labeled_df(spark).withColumn(
+        "text", ["x"] * _labeled_df(spark).count())
+    grid = ParamGridBuilder().addGrid(est.threshold,
+                                      [0.5, 2.0, 3.5]).build()
+    cv = CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                        evaluator=MulticlassClassificationEvaluator(
+                            metricName="accuracy"),
+                        numFolds=3, seed=5)
+    model = cv.fit(df)
+    assert int(np.argmax(model.avgMetrics)) == 1  # threshold=2.0
+    assert model.bestModel.stages[-1]._t == 2.0
+
+
+def test_foreign_params_ignored_on_copy():
+    a, b = _ThresholdClassifier(), _ThresholdClassifier()
+    copied = a.copy({b.threshold: 9.0})  # b's param: not a's to apply
+    assert copied.getOrDefault(copied.threshold) == 0.0
+    copied2 = a.copy({a.threshold: 9.0})
+    assert copied2.getOrDefault(copied2.threshold) == 9.0
