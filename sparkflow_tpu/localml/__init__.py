@@ -33,10 +33,13 @@ from .feature import (VectorAssembler, OneHotEncoder, Normalizer,
                       WordpieceEncoder, Tokenizer, StopWordsRemover,
                       StringIndexer, StringIndexerModel,
                       StandardScaler, StandardScalerModel,
-                      MinMaxScaler, MinMaxScalerModel, Bucketizer)
+                      MinMaxScaler, MinMaxScalerModel, Bucketizer,
+                      IndexToString, PCA, PCAModel, Imputer,
+                      ImputerModel)
 from .pipeline import Pipeline, PipelineModel
 from .evaluation import (MulticlassClassificationEvaluator,
-                         BinaryClassificationEvaluator)
+                         BinaryClassificationEvaluator,
+                         RegressionEvaluator)
 from .tuning import (ParamGridBuilder, CrossValidator, CrossValidatorModel,
                      TrainValidationSplit, TrainValidationSplitModel)
 
@@ -51,6 +54,8 @@ __all__ = [
     "MinMaxScalerModel", "Bucketizer",
     "Pipeline", "PipelineModel",
     "MulticlassClassificationEvaluator", "BinaryClassificationEvaluator",
+    "RegressionEvaluator", "IndexToString", "PCA", "PCAModel",
+    "Imputer", "ImputerModel",
     "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
     "TrainValidationSplit", "TrainValidationSplitModel",
 ]

@@ -106,3 +106,45 @@ class BinaryClassificationEvaluator(HasLabelCol, HasPredictionCol):
             rec = np.concatenate([[0.0], tp / P])
             return float(np.trapezoid(prec, rec))
         raise ValueError(f"unsupported metric {metric!r}")
+
+
+class RegressionEvaluator(HasLabelCol, HasPredictionCol):
+    """rmse (default) / mse / mae / r2 over a numeric prediction column
+    (pyspark.ml.evaluation.RegressionEvaluator)."""
+
+    metricName = Param(Params._dummy(), "metricName", "metric name",
+                       typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, predictionCol="prediction", labelCol="label",
+                 metricName="rmse"):
+        super().__init__()
+        self._setDefault(predictionCol="prediction", labelCol="label",
+                         metricName="rmse")
+        self._set(**self._input_kwargs)
+
+    def isLargerBetter(self) -> bool:
+        # errors shrink toward better; r2 grows
+        return self.getOrDefault(self.metricName) == "r2"
+
+    def evaluate(self, dataset) -> float:
+        label_col = self.getOrDefault(self.labelCol)
+        pred_col = self.getOrDefault(self.predictionCol)
+        metric = self.getOrDefault(self.metricName)
+        rows = dataset.collect()
+        y = np.array([float(r[label_col]) for r in rows])
+        p = np.array([float(r[pred_col]) for r in rows])
+        if len(y) == 0:
+            return 0.0
+        err = y - p
+        if metric == "mse":
+            return float(np.mean(err ** 2))
+        if metric == "rmse":
+            return float(np.sqrt(np.mean(err ** 2)))
+        if metric == "mae":
+            return float(np.mean(np.abs(err)))
+        if metric == "r2":
+            ss_tot = float(np.sum((y - y.mean()) ** 2))
+            ss_res = float(np.sum(err ** 2))
+            return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+        raise ValueError(f"unsupported metric {metric!r}")

@@ -460,3 +460,143 @@ class Bucketizer(Transformer, HasInputCol, HasOutputCol):
                                  f"[{splits[0]}, {splits[-1]}]")
             rows.append(Row(**{**r.asDict(), out_col: b}))
         return DataFrame(rows, cols, dataset.num_partitions)
+
+
+class IndexToString(Transformer, HasInputCol, HasOutputCol):
+    """Inverse of StringIndexer: double index -> label string. ``labels``
+    may be given explicitly (pyspark uses column metadata, which the local
+    engine doesn't carry — pass the fitted StringIndexerModel's labels)."""
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, labels=None):
+        super().__init__()
+        self._labels = list(labels) if labels is not None else None
+        kw = dict(self._input_kwargs)
+        kw.pop("labels", None)
+        self._set(**{k: v for k, v in kw.items() if v is not None})
+
+    def setLabels(self, labels) -> "IndexToString":
+        self._labels = list(labels)
+        return self
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        if not self._labels:
+            raise ValueError("IndexToString needs labels= (the local engine "
+                             "carries no column metadata)")
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        vals = []
+        for r in dataset.collect():
+            i = int(r[in_col])
+            if not 0 <= i < len(self._labels):
+                raise ValueError(f"index {i} out of range for "
+                                 f"{len(self._labels)} labels")
+            vals.append(self._labels[i])
+        return _with_col(dataset, out_col, vals)
+
+
+class PCAModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, pc=None, explained_variance=None):
+        super().__init__()
+        # principal components [n_features, k], column-major like pyspark
+        self.pc = np.asarray(pc) if pc is not None else None
+        self.explainedVariance = (list(explained_variance)
+                                  if explained_variance is not None else [])
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.outputCol)
+        vals = [Vectors.dense(vector_to_array(r[in_col]).astype(float)
+                              @ self.pc)
+                for r in dataset.collect()]
+        return _with_col(dataset, out_col, vals)
+
+
+class PCA(Estimator, HasInputCol, HasOutputCol):
+    """Project vectors onto the top-k principal components. Like Spark
+    MLlib, inputs are NOT re-centered at transform time; the components are
+    computed from the centered covariance (SVD of X - mean)."""
+
+    k = Param(Params._dummy(), "k", "number of components",
+              typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, k=None, inputCol=None, outputCol=None):
+        super().__init__()
+        self._set(**{k_: v for k_, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def getK(self) -> int:
+        return self.getOrDefault(self.k)
+
+    def _fit(self, dataset: DataFrame) -> PCAModel:
+        k = self.getK()
+        mat = np.stack([vector_to_array(r[self.getOrDefault(self.inputCol)])
+                        .astype(float) for r in dataset.collect()])
+        if k > mat.shape[1]:
+            raise ValueError(f"k={k} > n_features={mat.shape[1]}")
+        centered = mat - mat.mean(axis=0)
+        _, svals, vt = np.linalg.svd(centered, full_matrices=False)
+        var = (svals ** 2) / max(mat.shape[0] - 1, 1)
+        ratio = var / var.sum() if var.sum() > 0 else var
+        m = PCAModel(vt[:k].T, ratio[:k])
+        m._set(inputCol=self.getOrDefault(self.inputCol),
+               outputCol=self.getOrDefault(self.outputCol))
+        return m
+
+
+class ImputerModel(Model):
+    def __init__(self, surrogates=None, input_cols=None, output_cols=None):
+        super().__init__()
+        self.surrogates = dict(surrogates or {})
+        self._in = list(input_cols or [])
+        self._out = list(output_cols or [])
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        out = dataset
+        for ic, oc in zip(self._in, self._out):
+            vals = []
+            for r in out.collect():
+                v = r[ic]
+                bad = v is None or (isinstance(v, float) and v != v)
+                vals.append(self.surrogates[ic] if bad else float(v))
+            out = _with_col(out, oc, vals)
+        return out
+
+
+class Imputer(Estimator):
+    """Replace missing values (null/NaN) in numeric columns with the
+    column's mean or median (pyspark.ml.feature.Imputer)."""
+
+    inputCols = Param(Params._dummy(), "inputCols", "columns to impute",
+                      typeConverter=TypeConverters.toListString)
+    outputCols = Param(Params._dummy(), "outputCols", "imputed columns",
+                       typeConverter=TypeConverters.toListString)
+    strategy = Param(Params._dummy(), "strategy", "mean|median",
+                     typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, inputCols=None, outputCols=None, strategy="mean"):
+        super().__init__()
+        self._setDefault(strategy="mean")
+        self._set(**{k: v for k, v in self._input_kwargs.items()
+                     if v is not None})
+
+    def _fit(self, dataset: DataFrame) -> ImputerModel:
+        ics = self.getOrDefault(self.inputCols)
+        ocs = self.getOrDefault(self.outputCols)
+        strat = self.getOrDefault(self.strategy)
+        if strat not in ("mean", "median"):
+            raise ValueError(f"strategy must be mean|median, got {strat!r}")
+        if len(ics) != len(ocs):
+            raise ValueError("inputCols and outputCols must align")
+        surrogates = {}
+        for c in ics:
+            good = [float(r[c]) for r in dataset.collect()
+                    if r[c] is not None
+                    and not (isinstance(r[c], float) and r[c] != r[c])]
+            if not good:
+                raise ValueError(f"column {c!r} has no non-missing values")
+            surrogates[c] = (float(np.mean(good)) if strat == "mean"
+                             else float(np.median(good)))
+        return ImputerModel(surrogates, ics, ocs)

@@ -324,3 +324,75 @@ def test_writer_mode_validation(spark, tmp_path):
     with pytest.raises(IOError, match="mode='error'"):
         df.write.json(p)
     df.write.mode("ignore").json(p)  # silently keeps the old file
+
+
+# ---------------------------------------------------------------------------
+# IndexToString / PCA / Imputer / RegressionEvaluator
+# ---------------------------------------------------------------------------
+
+def test_index_to_string_round_trip(spark):
+    from sparkflow_tpu.localml import IndexToString, StringIndexer
+
+    df = spark.createDataFrame([("b",), ("a",), ("b",)], ["cat"])
+    m = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    idx_df = m.transform(df)
+    back = IndexToString(inputCol="idx", outputCol="orig",
+                         labels=m.labels).transform(idx_df)
+    assert [r["orig"] for r in back.collect()] == ["b", "a", "b"]
+    with pytest.raises(ValueError, match="needs labels"):
+        IndexToString(inputCol="idx", outputCol="o").transform(idx_df)
+
+
+def test_pca_matches_numpy_svd(spark):
+    from sparkflow_tpu.localml import PCA
+
+    rs = np.random.RandomState(0)
+    # anisotropic cloud: variance concentrated along one direction
+    base = rs.randn(40, 1) @ np.array([[3.0, 1.0, 0.2]]) + rs.randn(40, 3) * 0.1
+    df = spark.createDataFrame([(Vectors.dense(r),) for r in base], ["f"])
+    m = PCA(k=2, inputCol="f", outputCol="p").fit(df)
+    assert m.pc.shape == (3, 2)
+    assert m.explainedVariance[0] > 0.9          # first pc dominates
+    out = np.stack([np.asarray(r["p"].toArray())
+                    for r in m.transform(df).collect()])
+    np.testing.assert_allclose(out, base @ m.pc, atol=1e-9)
+    # projections onto orthonormal components preserve centered variance
+    centered = base - base.mean(0)
+    np.testing.assert_allclose(
+        np.var(centered @ m.pc, axis=0).sum() / np.var(centered, axis=0).sum(),
+        sum(m.explainedVariance), rtol=1e-6)
+    with pytest.raises(ValueError, match="n_features"):
+        PCA(k=7, inputCol="f", outputCol="p").fit(df)
+
+
+def test_imputer_mean_and_median(spark):
+    from sparkflow_tpu.localml import Imputer
+
+    rows = [(1.0, 10.0), (float("nan"), 20.0), (4.0, None), (7.0, 30.0)]
+    df = spark.createDataFrame(rows, ["a", "b"])
+    m = Imputer(inputCols=["a", "b"], outputCols=["ai", "bi"]).fit(df)
+    out = m.transform(df).collect()
+    assert out[1]["ai"] == pytest.approx(4.0)    # mean of 1,4,7
+    assert out[2]["bi"] == pytest.approx(20.0)   # mean of 10,20,30
+    m2 = Imputer(inputCols=["a"], outputCols=["ai"],
+                 strategy="median").fit(df)
+    assert m2.surrogates["a"] == pytest.approx(4.0)
+    with pytest.raises(ValueError, match="strategy"):
+        Imputer(inputCols=["a"], outputCols=["x"], strategy="mode").fit(df)
+
+
+def test_regression_evaluator(spark):
+    from sparkflow_tpu.localml import RegressionEvaluator
+
+    rows = [(1.0, 1.5), (2.0, 2.0), (3.0, 2.5)]
+    df = spark.createDataFrame(rows, ["label", "prediction"])
+    assert RegressionEvaluator(metricName="mae").evaluate(df) \
+        == pytest.approx(1.0 / 3)
+    assert RegressionEvaluator(metricName="mse").evaluate(df) \
+        == pytest.approx((0.25 + 0 + 0.25) / 3)
+    assert RegressionEvaluator().evaluate(df) \
+        == pytest.approx(np.sqrt((0.25 + 0 + 0.25) / 3))  # rmse default
+    r2 = RegressionEvaluator(metricName="r2")
+    assert r2.evaluate(df) == pytest.approx(1 - 0.5 / 2.0)
+    assert r2.isLargerBetter()
+    assert not RegressionEvaluator().isLargerBetter()
