@@ -642,3 +642,68 @@ def test_depthwise_conv_and_lrn_match_session():
     mg, w, tf_out = _session_fwd(build, ["out:0"], {"x:0": X})
     out = _compat_fwd(mg, w, ["out:0"], {"x:0": X})
     np.testing.assert_allclose(out["out:0"], tf_out["out:0"], atol=1e-4)
+
+
+def test_differential_fuzz_extended_ops():
+    """Second fuzz axis: random graphs drawing from the round-2 op widening
+    (leaky_relu, sin/cos, add_n, batch norm, cumsum, one_hot-free paths) —
+    forward AND loss differential vs a live session."""
+    from google.protobuf import json_format
+    from sparkflow_tpu.graphdef import list_to_params
+
+    rs = np.random.RandomState(7)
+    trials = int(os.environ.get("SPARKFLOW_FUZZ_TRIALS", "5"))
+
+    def spice(h, width, trial, rs2):
+        """Random extra op sandwiched between dense layers."""
+        choice = rs2.randint(6)
+        if choice == 0:
+            return tf.nn.leaky_relu(h, alpha=float(rs2.uniform(0.05, 0.4)))
+        if choice == 1:
+            return tf.sin(h) + tf.cos(h) * 0.5
+        if choice == 2:
+            return tf1.add_n([h, tf.square(h) * 0.1, h * 0.5])
+        if choice == 3:
+            gamma = tf1.get_variable(f"g{trial}_{width}", [width],
+                                     initializer=tf1.ones_initializer())
+            beta = tf1.get_variable(f"b{trial}_{width}", [width],
+                                    initializer=tf1.zeros_initializer())
+            n, _, _ = tf1.nn.fused_batch_norm(
+                tf.reshape(h, [-1, 1, 1, width]), gamma, beta,
+                is_training=True)
+            return tf.reshape(n, [-1, width])
+        if choice == 4:
+            return tf.cumsum(h, axis=1) * 0.2
+        return tf.nn.softsign(h)
+
+    for trial in range(trials):
+        in_dim = int(rs.randint(3, 7))
+        w1, w2 = int(rs.randint(3, 8)), int(rs.randint(2, 6))
+
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, in_dim], name="x")
+            y = tf1.placeholder(tf.float32, [None, w2], name="y")
+            h = _dense(x, w1, f"d1_{trial}", None)
+            h = spice(h, w1, trial, rs)
+            out = _dense(h, w2, f"d2_{trial}")
+            tf1.losses.mean_squared_error(y, out)
+            out_name = out.name
+            mg = json_format.MessageToJson(tf1.train.export_meta_graph())
+            with tf1.Session(graph=g) as sess:
+                sess.run(tf1.global_variables_initializer())
+                w = sess.run(tf1.trainable_variables())
+                X = rs.rand(6, in_dim).astype(np.float32)
+                Y = rs.rand(6, w2).astype(np.float32)
+                tf_out = sess.run(out_name, {"x:0": X})
+                loss_name = tf1.get_collection(tf1.GraphKeys.LOSSES)[0].name
+                tf_loss = sess.run(loss_name, {"x:0": X, "y:0": Y})
+
+        m = model_from_json(mg)
+        params = list_to_params(m, w)
+        got = np.asarray(m.apply(params, {"x": X}, [out_name])[out_name])
+        np.testing.assert_allclose(got, tf_out, atol=1e-4,
+                                   err_msg=f"extended trial {trial}")
+        lv = np.asarray(m.loss_vector(params, {"x": X, "y": Y}, train=False))
+        np.testing.assert_allclose(lv.mean(), float(tf_loss), rtol=1e-4,
+                                   err_msg=f"extended trial {trial} loss")
