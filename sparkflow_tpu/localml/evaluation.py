@@ -99,12 +99,14 @@ class BinaryClassificationEvaluator(HasLabelCol, HasPredictionCol):
         P, N = tp[-1], fp[-1]
         tpr = np.concatenate([[0.0], tp / P])
         fpr = np.concatenate([[0.0], fp / N])
+        # np.trapezoid is numpy>=2 only; np.trapz its 1.x name
+        _trapz = getattr(np, "trapezoid", None) or np.trapz
         if metric == "areaUnderROC":
-            return float(np.trapezoid(tpr, fpr))
+            return float(_trapz(tpr, fpr))
         if metric == "areaUnderPR":
             prec = np.concatenate([[1.0], tp / np.maximum(tp + fp, 1)])
             rec = np.concatenate([[0.0], tp / P])
-            return float(np.trapezoid(prec, rec))
+            return float(_trapz(prec, rec))
         raise ValueError(f"unsupported metric {metric!r}")
 
 

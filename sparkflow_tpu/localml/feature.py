@@ -419,7 +419,7 @@ class Bucketizer(Transformer, HasInputCol, HasOutputCol):
     splits = Param(Params._dummy(), "splits", "bucket split points",
                    typeConverter=TypeConverters.toListFloat)
     handleInvalid = Param(Params._dummy(), "handleInvalid",
-                          "error|skip|keep for NaN entries",
+                          "error|skip|keep for NaN/null entries",
                           typeConverter=TypeConverters.toString)
 
     @keyword_only
@@ -439,15 +439,16 @@ class Bucketizer(Transformer, HasInputCol, HasOutputCol):
         rows, cols = [], dataset.columns + (
             [out_col] if out_col not in dataset.columns else [])
         for r in dataset.collect():
-            v = float(r[in_col])
+            raw = r[in_col]
+            v = float("nan") if raw is None else float(raw)
             if np.isnan(v):
-                # Spark 2.4: handleInvalid governs NaN entries ONLY
+                # Spark 2.4: handleInvalid governs NaN AND null entries
                 if hi_mode == "keep":
                     b = float(n_buckets)
                 elif hi_mode == "skip":
                     continue
                 else:
-                    raise ValueError("NaN value in Bucketizer input "
+                    raise ValueError("NaN/null value in Bucketizer input "
                                      "(handleInvalid='error')")
             elif v == splits[-1]:
                 b = float(n_buckets - 1)

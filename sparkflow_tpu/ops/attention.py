@@ -523,8 +523,8 @@ def flash_attention(q, k, v, causal: bool = False,
         interpret = not on_tpu
     # p-tile is block_q*block_k f32: cap the product at 2^20 (4 MB VMEM)
     cap = 1024 if d <= 128 else 512
-    bwd_block_q = block_q if block_q is not None else _auto_block(s, 512)
-    bwd_block_k = block_k if block_k is not None else _auto_block(sk, 512)
+    bwd_block_q = min(block_q, s) if block_q is not None else _auto_block(s, 512)
+    bwd_block_k = min(block_k, sk) if block_k is not None else _auto_block(sk, 512)
     block_q = min(block_q, s) if block_q is not None else _auto_block(s, cap)
     block_k = min(block_k, sk) if block_k is not None else _auto_block(sk, cap)
     # the XLA blockwise path materializes [B,H,S,block_k] f32 score blocks
@@ -540,7 +540,10 @@ def flash_attention(q, k, v, causal: bool = False,
     # (lanes); sequences must tile exactly (pad upstream otherwise)
     tiles_ok = (pltpu is not None
                 and s % block_q == 0 and sk % block_k == 0
-                and block_q % 8 == 0 and block_k % 128 == 0 and d % 8 == 0)
+                and s % bwd_block_q == 0 and sk % bwd_block_k == 0
+                and block_q % 8 == 0 and block_k % 128 == 0
+                and bwd_block_q % 8 == 0 and bwd_block_k % 128 == 0
+                and d % 8 == 0)
     if not tiles_ok:
         if kv_mask is None:
             return attention_reference(q, k, v, causal, scale)

@@ -106,6 +106,14 @@ def test_bucketizer(spark):
     assert b_keep.transform(nan_df).collect()[0]["bucket"] == 3.0
     with pytest.raises(ValueError, match="NaN"):
         b.transform(nan_df).collect()
+    # null entries follow the same handleInvalid path as NaN (pyspark)
+    null_df = spark.createDataFrame([(None,)], ["v"])
+    assert b_keep.transform(null_df).collect()[0]["bucket"] == 3.0
+    with pytest.raises(ValueError, match="NaN"):
+        b.transform(null_df).collect()
+    b_skip = Bucketizer(splits=[-1.0, 0.0, 1.0, 2.0], inputCol="v",
+                        outputCol="bucket", handleInvalid="skip")
+    assert b_skip.transform(null_df).collect() == []
 
 
 def test_binary_evaluator_auc(spark):

@@ -272,5 +272,5 @@ def test_torch_import_shape_mismatch_fails_loudly(tmp_path):
         x = nn.placeholder([None, 4], name="x")
         nn.dense(x, 2, name="out")
 
-    with pytest.raises(ValueError, match="no state_dict tensor fits"):
+    with pytest.raises(ValueError, match="no torch state_dict tensor fits"):
         extract_torch_weights(path, build_graph(graph))

@@ -336,6 +336,27 @@ def test_auto_block_selection():
     assert _auto_block(320, 1024) == 128   # 320 % 128 != 0 -> kernel falls back
 
 
+def test_flash_explicit_oversized_blocks_clamp_backward():
+    """Explicit block_q/block_k larger than the sequence must clamp on the
+    BACKWARD path too: an unclamped 512 at seq 256 makes the dq/dkv grids
+    ``s // bwd_block == 0`` and the gradients come back unwritten."""
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32)
+
+    def loss(a, b_, c):
+        return flash_attention(a, b_, c, block_q=512, block_k=512,
+                               interpret=True).sum()
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b_, c: attention_reference(a, b_, c).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=3e-4)
+
+
 def test_flash_short_query_cross_attention_keeps_kernel():
     """s=64 queries against sk=256 keys still runs the (interpret) pallas
     kernel via the short-seq clamp, matching the reference numerics."""
