@@ -223,8 +223,24 @@ def load_tensorflow_model(path: str,
             "or a MetaGraphDef JSON string.")
     from .graphdef import list_to_params
     from .models import model_from_json
-    from .tf1_compat import TF1GraphModel
+    from .tf1_compat import TF1GraphModel, bake_nontrainable_values
     model = model_from_json(graph_json)
+    if isinstance(model, TF1GraphModel):
+        # restore NON-trainable state too (batch-norm moving statistics):
+        # the reference imports tf.trainable_variables() only
+        # (tensorflow_model_loader.py:23-24), so trained BN models serve
+        # with fresh 0/1 stats there — here the checkpoint values are baked
+        # into the graph JSON as Const initializers and ride the wire format
+        state_names = model.nontrainable_variables()
+        if state_names:
+            import tensorflow as tf
+            reader = tf.train.load_checkpoint(path)
+            in_ckpt = reader.get_variable_to_shape_map()
+            state = {n: np.asarray(reader.get_tensor(n))
+                     for n in state_names if n in in_ckpt}
+            if state:
+                graph_json = bake_nontrainable_values(graph_json, state)
+                model = model_from_json(graph_json)
     try:
         if var_order is None and isinstance(model, TF1GraphModel):
             # metagraph knows its variables BY NAME in creation order —

@@ -42,12 +42,10 @@ class _MoEMixin:
         self.capacity_factor = capacity_factor
         self.router_top_k = max(1, min(router_top_k, num_experts))
         # ep_axis: run the FFN via all_to_all dispatch inside shard_map over
-        # this mesh axis (ops/moe_dispatch; top-1 only) — the communicating
-        # form of expert parallelism; None keeps the GSPMD slot dispatch
+        # this mesh axis (ops/moe_dispatch; top-k like the GSPMD form) — the
+        # communicating form of expert parallelism; None keeps the GSPMD
+        # slot dispatch
         self.ep_axis = ep_axis
-        if ep_axis is not None and self.router_top_k != 1:
-            raise ValueError("all_to_all dispatch (ep_axis) supports "
-                             "router_top_k=1 only")
 
     def _is_moe_layer(self, i: int) -> bool:
         return (i % self.moe_every) == (self.moe_every - 1)
@@ -114,7 +112,8 @@ class _MoEMixin:
             return all_to_all_moe_ffn(
                 x, bp["router"], bp["experts_fc1"], bp["experts_b1"],
                 bp["experts_fc2"], bp["experts_b2"], self.ep_axis,
-                self.num_experts, self.capacity_factor, token_mask)
+                self.num_experts, self.capacity_factor, token_mask,
+                top_k=self.router_top_k)
         b, s, h = x.shape
         e = self.num_experts
         k = self.router_top_k

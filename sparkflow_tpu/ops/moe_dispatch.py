@@ -12,6 +12,11 @@ move to their expert's device over ICI and return. With ``E == n`` (one
 expert per device — the common pod configuration) there is zero redundant
 FLOP anywhere. Used inside ``shard_map`` (see
 ``parallel/ep.make_moe_shardmap_train_step``).
+
+Routing is top-k (k=1 gives Switch semantics, k>1 the GShard renormalized
+gates), with first choices claiming buffer capacity before any second
+choice — the same priority rule as the GSPMD slot dispatch, so the two
+forms compute identical outputs when capacity covers every choice.
 """
 
 from __future__ import annotations
@@ -22,8 +27,9 @@ import jax.numpy as jnp
 
 def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
                        experts_b2, axis_name: str, num_experts: int,
-                       capacity_factor: float = 1.25, token_mask=None):
-    """Top-1 routed expert FFN with all_to_all dispatch.
+                       capacity_factor: float = 1.25, token_mask=None,
+                       top_k: int = 1, return_overflow: bool = False):
+    """Top-k routed expert FFN with all_to_all dispatch.
 
     Args (device-local views inside shard_map over ``axis_name``):
       x            [B_local, S, H] token activations (batch sharded)
@@ -33,10 +39,14 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
       experts_fc2  [E_local, M, H]
       experts_b2   [E_local, H]
       token_mask   optional [B_local, S]; masked tokens claim no capacity
+      top_k        experts per token (1 = Switch; >1 = GShard renormalized)
+      return_overflow  also return the fraction of live routed choices this
+                       device DROPPED for lack of send-buffer capacity
 
-    Returns ``(combined [B_local, S, H], aux_loss scalar-per-device)``.
-    The aux loss is the Switch load-balance term over LOCAL tokens; callers
-    typically ``pmean`` it across the axis.
+    Returns ``(combined [B_local, S, H], aux_loss scalar-per-device)`` — plus
+    the overflow fraction when requested. The aux loss is the Switch
+    load-balance term over LOCAL tokens; callers typically ``pmean`` it
+    across the axis.
     """
     try:
         n = jax.lax.axis_size(axis_name)
@@ -49,45 +59,66 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
     b, s, h = x.shape
     nl = b * s                      # local tokens
     e = num_experts
+    k = max(1, min(top_k, e))
     e_local = experts_fc1.shape[0]
     assert e_local * n == e, (e_local, n, e)
-    # per (device -> peer) buffer capacity: tokens THIS device may send to
-    # one peer. cf * nl / n is the balanced share; generous by design.
-    cap = max(1, int(-(-capacity_factor * nl // n)))
+    # per (device -> peer) buffer capacity: routed choices THIS device may
+    # send to one peer. cf * nl * k / n is the balanced share across the k
+    # choices; generous by design.
+    cap = max(1, int(-(-capacity_factor * nl * k // n)))
 
     xf = x.reshape(nl, h)
     logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), router_w)
     probs = jax.nn.softmax(logits, axis=-1)                 # [Nl, E]
-    expert_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
-    gate = jnp.max(probs, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)             # [Nl, k]
+    top_idx = top_idx.astype(jnp.int32)
+    if k == 1:
+        gates = top_vals  # Switch semantics: gate = max prob
+    else:
+        # GShard top-k: gates renormalized over the chosen experts
+        gates = top_vals / jnp.maximum(
+            jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
     live = (token_mask.reshape(nl).astype(jnp.float32)
             if token_mask is not None else jnp.ones((nl,), jnp.float32))
 
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32) * live[:, None]
-    aux = e * jnp.sum((jnp.sum(onehot, axis=0)
+    onehot1 = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32) * live[:, None]
+    aux = e * jnp.sum((jnp.sum(onehot1, axis=0)
                        / jnp.maximum(jnp.sum(live), 1.0))
                       * (jnp.sum(probs * live[:, None], axis=0)
                          / jnp.maximum(jnp.sum(live), 1.0)))
 
-    # destination peer for each token + position in that peer's send buffer
-    dest = expert_idx // e_local                            # [Nl]
-    dest_oh = jax.nn.one_hot(dest, n, dtype=jnp.float32) * live[:, None]
-    pos = jnp.sum((jnp.cumsum(dest_oh, axis=0) - 1.0) * dest_oh,
-                  axis=-1).astype(jnp.int32)
-    kept = (pos < cap) & (live > 0)
-    slot = jnp.where(kept, dest * cap + pos, n * cap)       # overflow bin
+    # destination peer per (choice, token), positions via cumsum over the
+    # choice-major stack: ALL first choices claim send-buffer slots before
+    # any second choice (GShard priority, same as the GSPMD path)
+    dest = top_idx // e_local                               # [Nl, k]
+    dest_oh = (jax.nn.one_hot(dest, n, dtype=jnp.float32)
+               * live[:, None, None])                       # [Nl, k, n]
+    stacked = jnp.transpose(dest_oh, (1, 0, 2)).reshape(k * nl, n)
+    pos_all = jnp.cumsum(stacked, axis=0) - 1.0             # [k*Nl, n]
 
-    # scatter tokens into [n, cap] send buffers (+1 overflow row)
-    token_for_slot = jnp.full((n * cap + 1,), nl, dtype=jnp.int32)
-    token_for_slot = token_for_slot.at[slot].set(
-        jnp.arange(nl, dtype=jnp.int32))[:n * cap]
     xf_pad = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)], axis=0)
-    send_x = xf_pad[token_for_slot].reshape(n, cap, h)
+    # token_for_slot stores the FLAT choice-token id ci*nl + t (sentinel
+    # k*nl); the flat id recovers both the token row and the choice's expert
+    token_for_slot = jnp.full((n * cap + 1,), k * nl, dtype=jnp.int32)
+    slots, kept_live = [], []
+    for ci in range(k):
+        oh = stacked[ci * nl:(ci + 1) * nl]                 # [Nl, n]
+        pos = jnp.sum(pos_all[ci * nl:(ci + 1) * nl] * oh,
+                      axis=-1).astype(jnp.int32)            # [Nl]
+        kept = (pos < cap) & (live > 0)
+        slot = jnp.where(kept, dest[:, ci] * cap + pos, n * cap)
+        token_for_slot = token_for_slot.at[slot].set(
+            ci * nl + jnp.arange(nl, dtype=jnp.int32))
+        slots.append(slot)
+        kept_live.append(kept)
+    tfs = token_for_slot[:n * cap]
+    tok_idx = jnp.where(tfs < k * nl, tfs % nl, nl)         # pad row on empty
+    send_x = xf_pad[tok_idx].reshape(n, cap, h)
     # sidecar: which LOCAL expert on the destination + validity
-    le_pad = jnp.concatenate(
-        [(expert_idx % e_local), jnp.zeros((1,), jnp.int32)])
-    send_le = le_pad[token_for_slot].reshape(n, cap)
-    send_valid = (token_for_slot < nl).astype(jnp.float32).reshape(n, cap)
+    le_flat = (top_idx % e_local).T.reshape(k * nl)         # choice-major
+    le_pad = jnp.concatenate([le_flat, jnp.zeros((1,), jnp.int32)])
+    send_le = le_pad[jnp.minimum(tfs, k * nl)].reshape(n, cap)
+    send_valid = (tfs < k * nl).astype(jnp.float32).reshape(n, cap)
 
     # the exchange: slab j of send goes to peer j; recv slab j came from j
     recv_x = jax.lax.all_to_all(send_x, axis_name, 0, 0, tiled=False)
@@ -105,10 +136,18 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
     out = out + experts_b2.astype(out.dtype)[:, None, :]
     out = jnp.einsum("eth,te->th", out, le_oh.astype(out.dtype))
 
-    # send results home and combine into original token positions
+    # send results home and combine into original token positions; each
+    # token reads its k result slots back, weighted by its gates (overflow
+    # slot row is zero: dropped choices contribute nothing)
     back = jax.lax.all_to_all(out.reshape(n, cap, h), axis_name, 0, 0,
                               tiled=False)
     back_pad = jnp.concatenate([back.reshape(n * cap, h),
                                 jnp.zeros((1, h), back.dtype)], axis=0)
-    y = back_pad[slot] * gate[:, None].astype(back.dtype)
-    return y.reshape(b, s, h).astype(x.dtype), aux
+    y = sum(back_pad[slots[ci]] * gates[:, ci:ci + 1].astype(back.dtype)
+            for ci in range(k))
+    y = y.reshape(b, s, h).astype(x.dtype)
+    if not return_overflow:
+        return y, aux
+    routed = jnp.maximum(jnp.sum(live) * k, 1.0)
+    kept_n = sum(jnp.sum(jnp.where(kl, live, 0.0)) for kl in kept_live)
+    return y, aux, 1.0 - kept_n / routed

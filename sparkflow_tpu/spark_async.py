@@ -378,9 +378,6 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             raise ValueError(
                 "extraInputCols (%d names) and extraTfInputs (%d names) must "
                 "pair up one-to-one" % (len(extra_cols), len(extra_inputs)))
-        if extra_cols and fit_mode == "stream":
-            raise ValueError("fitMode='stream' supports a single input "
-                             "column; use collect mode for multi-input models")
         mesh_axes = None
         mesh_shape = self.getMeshShape()
         if mesh_shape:

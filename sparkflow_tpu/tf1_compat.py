@@ -43,6 +43,78 @@ _DTYPES = {
 
 _VAR_OPS = ("VarHandleOp", "VariableV2", "Variable")
 
+# suffix marking a Const node that carries CHECKPOINT-RESTORED state baked
+# into the graph JSON by bake_nontrainable_values — the marker suppresses the
+# fresh-init warning in the evaluator's non-trainable variable fallback
+_BAKED_SUFFIX = "/imported_value"
+
+_NP_TO_DT = {np.dtype(np.float32): "DT_FLOAT", np.dtype(np.float64): "DT_DOUBLE",
+             np.dtype(np.int32): "DT_INT32", np.dtype(np.int64): "DT_INT64",
+             np.dtype(np.bool_): "DT_BOOL", np.dtype(np.float16): "DT_HALF"}
+
+
+def bake_nontrainable_values(graph_json, values) -> str:
+    """Embed restored non-trainable variable values (batch-norm moving
+    statistics and the like) into a MetaGraphDef JSON as Const initializers.
+
+    The reference's wire format carries *trainable* variables only
+    (``sparkflow/tensorflow_model_loader.py:23-24`` extracts
+    ``tf.trainable_variables()``), so a trained BN model round-trips with
+    fresh 0/1 moving stats — a shared reference bug this beats. Baking the
+    checkpoint tensors into the graph keeps the wire format self-contained:
+    the returned JSON serves correctly through the interpreter AND survives
+    pipeline persistence with no schema change.
+
+    ``values``: variable node name -> numpy array. Each variable's
+    initializer ``Assign`` is re-pointed at a new Const node holding the
+    tensor (created if the graph had no Assign for it).
+    """
+    d = json.loads(graph_json) if isinstance(graph_json, str) else dict(graph_json)
+    gd = d.get("graphDef") or d.get("graph_def")
+    if gd is None:
+        raise ValueError("not a MetaGraphDef JSON (no graphDef)")
+    nodes = gd.setdefault("node", [])
+    by_name = {n["name"]: n for n in nodes}
+    for vname, arr in values.items():
+        node = by_name.get(vname)
+        if node is None or node["op"] not in _VAR_OPS:
+            raise ValueError(f"{vname!r} is not a variable node in this graph")
+        arr = np.ascontiguousarray(arr)
+        dt = _NP_TO_DT.get(arr.dtype)
+        if dt is None:
+            raise ValueError(f"{vname!r}: unsupported dtype {arr.dtype}")
+        cname = vname + _BAKED_SUFFIX
+        const = {
+            "name": cname, "op": "Const",
+            "attr": {"dtype": {"type": dt},
+                     "value": {"tensor": {
+                         "dtype": dt,
+                         "tensorShape": {"dim": [{"size": str(s)}
+                                                 for s in arr.shape]},
+                         "tensorContent": base64.b64encode(
+                             arr.astype(arr.dtype.newbyteorder("<"))
+                             .tobytes()).decode("ascii")}}},
+        }
+        if cname in by_name:
+            by_name[cname].clear()
+            by_name[cname].update(const)
+        else:
+            nodes.append(const)
+            by_name[cname] = const
+        # re-point the variable's initializer Assign at the baked Const
+        assign = next((n for n in nodes
+                       if n.get("op") in ("Assign", "AssignVariableOp")
+                       and n.get("input", [None])[0].split(":")[0].lstrip("^")
+                       == vname), None)
+        if assign is not None:
+            ins = list(assign["input"])
+            ins[1] = cname
+            assign["input"] = ins
+        else:
+            nodes.append({"name": vname + "/imported_assign", "op": "Assign",
+                          "input": [vname, cname]})
+    return json.dumps(d)
+
 
 def is_tf1_metagraph(graph_json) -> bool:
     """Cheap sniff: is this (string or parsed dict) a MetaGraphDef JSON?
@@ -198,6 +270,14 @@ class TF1GraphModel:
                         self._var_init[target] = ins[1]
 
     # -- GraphModel duck type -------------------------------------------------
+
+    def nontrainable_variables(self) -> List[str]:
+        """Variable nodes outside the trainable collection (batch-norm moving
+        statistics etc.) — the state :func:`bake_nontrainable_values` can
+        restore from a checkpoint."""
+        trainable = set(self._var_order)
+        return [n["name"] for n in self._nodes.values()
+                if n["op"] in _VAR_OPS and n["name"] not in trainable]
 
     def _param_key(self, vname: str) -> Tuple[str, str]:
         if self._grouped and "/" in vname:
@@ -378,18 +458,22 @@ class _Evaluator:
                 return self.m._param_value(self.params, name)
             # non-trainable variable (e.g. batch-norm moving_mean/variance):
             # not in the trainable collection, so it has no params slot —
-            # evaluate its initializer subgraph instead. The wire format only
-            # carries trainables, so learned moving stats cannot survive a
-            # round-trip: warn, because inference through such a node uses
-            # FRESH-INIT values (0/1), not whatever the source graph learned
-            import warnings
-            warnings.warn(
-                f"reading non-trainable variable {name!r} via its initializer "
-                f"subgraph (the reference wire format carries trainable "
-                f"variables only); if this model relies on learned "
-                f"non-trainable state (e.g. batch-norm moving statistics), "
-                f"those values are fresh-initialized here", stacklevel=2)
+            # evaluate its initializer subgraph instead. Checkpoint imports
+            # bake restored values in as `<var>/imported_value` Consts
+            # (bake_nontrainable_values); WITHOUT a baked value the
+            # initializer yields FRESH-INIT state (0/1), not whatever the
+            # source graph learned — warn in that case only
             init_node = self.m._var_init.get(name)
+            if init_node is None or not init_node.endswith(_BAKED_SUFFIX):
+                import warnings
+                warnings.warn(
+                    f"reading non-trainable variable {name!r} via its "
+                    f"initializer subgraph (the reference wire format "
+                    f"carries trainable variables only); if this model "
+                    f"relies on learned non-trainable state (e.g. batch-norm "
+                    f"moving statistics), those values are fresh-initialized "
+                    f"here — import through load_tensorflow_model to restore "
+                    f"them from the checkpoint", stacklevel=2)
             if init_node is not None:
                 return self.value(init_node)
             shape = _attr_shape(node)

@@ -488,6 +488,11 @@ class Trainer:
         carry across epochs — multiple epochs here train identically to
         repeated passes over an in-memory dataset, not like restarted fits.
 
+        Multi-input models (``input_name`` a sequence) stream too: each row's
+        features travel as a TUPLE of vectors, ride the batch ring
+        concatenated into one flat row, and are split back into per-input
+        arrays before the train step.
+
         A native C++ batch-assembly thread (numpy fallback) pads/masks/
         shuffles fixed-shape batches concurrently with device compute; each
         batch is one synchronous optimizer step.
@@ -498,9 +503,7 @@ class Trainer:
         from .localml.linalg import vector_to_array
         from .utils.data import BatchQueue, feed_from_iterator
 
-        if isinstance(self.input_name, (list, tuple)):
-            raise ValueError("fit_stream feeds a single input tensor; use "
-                             "fit() for multi-input models")
+        multi = isinstance(self.input_name, (list, tuple))
         factory = row_iterator if callable(row_iterator) else None
         if epochs > 1 and factory is None:
             raise ValueError("epochs > 1 needs a callable iterator factory "
@@ -576,8 +579,22 @@ class Trainer:
                     first = next(it)
                 except StopIteration:
                     raise ValueError("no training data")
-                feat0 = vector_to_array(first[0] if supervised else first)
-                row_dim = int(feat0.shape[0])
+                raw0 = first[0] if supervised else first
+                if multi:
+                    if (not isinstance(raw0, tuple)
+                            or len(raw0) != len(self.input_name)):
+                        got = (f"a tuple of {len(raw0)}"
+                               if isinstance(raw0, tuple) else "a single vector")
+                        raise ValueError(
+                            f"model takes {len(self.input_name)} input "
+                            f"tensors ({self.input_name}) but the stream "
+                            f"yields {got} per row")
+                    part_dims = [int(vector_to_array(p).shape[0])
+                                 for p in raw0]
+                    split_at = list(np.cumsum(part_dims))[:-1]
+                    row_dim = int(sum(part_dims))
+                else:
+                    row_dim = int(vector_to_array(raw0).shape[0])
                 if supervised:
                     lbl0 = first[1]
                     label_dim = (1 if isinstance(lbl0, (int, float))
@@ -613,6 +630,11 @@ class Trainer:
                             q.close()
                             break
                         rng, srng = jax.random.split(rng)
+                        if multi:
+                            # split the concatenated ring row back into the
+                            # per-input arrays the loss feeds by tensor name
+                            x = tuple(np.ascontiguousarray(s) for s in
+                                      np.split(x, split_at, axis=1))
                         params, opt_state, loss = step(params, opt_state, x,
                                                        y if supervised else dummy_y,
                                                        mask, srng)

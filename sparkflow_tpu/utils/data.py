@@ -213,17 +213,25 @@ def feed_from_iterator(q: BatchQueue, it: Iterable, supervised: bool,
 
     def run():
         from ..ml_util import handle_features
+
+        def push(buf):
+            f, l = handle_features(buf, is_supervised=supervised)
+            if isinstance(f, tuple):
+                # multi-input rows ride the ring CONCATENATED into one flat
+                # row (the ring is a single matrix); the consumer splits the
+                # batch back into per-input arrays by the known widths
+                f = np.concatenate(f, axis=1)
+            q.push(f, l)
+
         buf = []
         try:
             for item in it:
                 buf.append(item)
                 if len(buf) >= chunk:
-                    f, l = handle_features(buf, is_supervised=supervised)
-                    q.push(f, l)
+                    push(buf)
                     buf.clear()
             if buf:
-                f, l = handle_features(buf, is_supervised=supervised)
-                q.push(f, l)
+                push(buf)
         finally:
             q.finish()
 

@@ -405,10 +405,45 @@ def test_extra_inputs_param_validation(spark, gaussian_df):
     est = base_estimator(mg, extraInputCols="a,b", extraTfInputs="only_one:0")
     with pytest.raises(ValueError, match="pair up"):
         est.fit(gaussian_df)
-    est2 = base_estimator(mg, extraInputCols="a", extraTfInputs="m:0",
-                          fitMode="stream")
-    with pytest.raises(ValueError, match="single input"):
-        est2.fit(gaussian_df)
+
+
+def test_multi_input_stream_mode_through_estimator(spark):
+    """fitMode='stream' + extraInputCols: multi-input rows ride the batch
+    ring as concatenated tuples and split back per input before the step
+    (round-2 restriction removed)."""
+    from sparkflow_tpu.models import build_registry_spec
+
+    seq, vocab = 8, 30
+    spec = build_registry_spec("transformer_classifier", vocab_size=vocab,
+                               num_classes=2, hidden=16, num_layers=1,
+                               num_heads=2, mlp_dim=32, max_len=seq,
+                               dropout=0.0)
+    rs = np.random.RandomState(0)
+    rows = []
+    for _ in range(60):
+        label = rs.randint(0, 2)
+        ids = rs.randint(3, vocab, seq)
+        if label:
+            ids[0] = 1  # marker token
+        n_real = rs.randint(seq // 2, seq + 1)
+        mask = np.zeros(seq); mask[:n_real] = 1.0
+        ids[n_real:] = 0
+        rows.append((float(label), Vectors.dense(ids.astype(float)),
+                     Vectors.dense(mask)))
+    df = spark.createDataFrame(rows, ["label", "tokens", "mask"])
+
+    est = SparkAsyncDL(inputCol="tokens", tensorflowGraph=spec,
+                       tfInput="input_ids:0", tfLabel="y:0",
+                       tfOutput="pred:0", tfOptimizer="adam",
+                       tfLearningRate=0.01, iters=30, partitions=2,
+                       labelCol="label", predictionCol="predicted",
+                       miniBatchSize=16, verbose=0, fitMode="stream",
+                       extraInputCols="mask", extraTfInputs="attention_mask:0")
+    model = est.fit(df)
+    preds = model.transform(df)
+    errs = sum(1 for r in preds.collect()
+               if round(float(r["predicted"])) != float(r["label"]))
+    assert errs < 15
 
 
 def test_model_transform_validates_extra_pairing(spark, gaussian_df):

@@ -274,3 +274,79 @@ def test_torch_import_shape_mismatch_fails_loudly(tmp_path):
 
     with pytest.raises(ValueError, match="no torch state_dict tensor fits"):
         extract_torch_weights(path, build_graph(graph))
+
+
+def test_tf1_batch_norm_moving_stats_import(tmp_path):
+    """A TRAINED batch-norm model must serve with the checkpoint's moving
+    statistics, matching a live tf.Session restore — the reference loses
+    them (tensorflow_model_loader.py:23-24 imports trainables only; the
+    import here bakes non-trainable state into the wire format)."""
+    import warnings
+
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+
+    rs = np.random.RandomState(3)
+    mm_v = rs.randn(6).astype(np.float32)
+    mv_v = (rs.rand(6) + 0.5).astype(np.float32)
+    X = rs.randn(5, 4).astype(np.float32)
+
+    g = tf1.Graph()
+    prefix = str(tmp_path / "bn_model")
+    with g.as_default(), tf1.Session(graph=g) as sess:
+        x = tf1.placeholder(tf.float32, [None, 4], name="x")
+        with tf1.variable_scope("dense"):
+            k = tf1.get_variable("kernel",
+                                 initializer=rs.randn(4, 6).astype(np.float32))
+            b = tf1.get_variable("bias",
+                                 initializer=rs.randn(6).astype(np.float32))
+        h = tf1.nn.bias_add(tf1.matmul(x, k), b)
+        with tf1.variable_scope("bn"):
+            gamma = tf1.get_variable(
+                "gamma", initializer=rs.randn(6).astype(np.float32))
+            beta = tf1.get_variable(
+                "beta", initializer=rs.randn(6).astype(np.float32))
+            mm = tf1.get_variable("moving_mean", trainable=False,
+                                  initializer=mm_v)
+            mv = tf1.get_variable("moving_variance", trainable=False,
+                                  initializer=mv_v)
+        n, _, _ = tf1.nn.fused_batch_norm(
+            tf.reshape(h, [-1, 1, 1, 6]), gamma, beta, mean=mm, variance=mv,
+            is_training=False)
+        tf1.identity(tf.reshape(n, [-1, 6]), name="out")
+        sess.run(tf1.global_variables_initializer())
+        tf_out = sess.run("out:0", {"x:0": X})  # live session, learned stats
+        tf1.train.Saver().save(sess, prefix)
+
+    # .meta becomes the serving graph; moving stats restore from the shards
+    model = load_tensorflow_model(prefix, "features", "x:0", "out:0")
+
+    from sparkflow_tpu.graphdef import list_to_params
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    from sparkflow_tpu.models import model_from_json
+
+    m = model_from_json(model.getOrDefault(model.modelJson))
+    params = list_to_params(m, convert_json_to_weights(
+        model.getOrDefault(model.modelWeights)))
+    with warnings.catch_warnings():
+        # serving must NOT hit the fresh-init warning: stats are baked in
+        warnings.simplefilter("error")
+        out = np.asarray(m.apply(params, {"x": X}, ["out:0"])["out:0"])
+    np.testing.assert_allclose(out, tf_out, atol=1e-5)
+
+
+def test_bake_nontrainable_values_validation():
+    """Baking rejects names that are not variable nodes in the graph."""
+    from sparkflow_tpu.tf1_compat import bake_nontrainable_values
+
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    from google.protobuf import json_format
+    g = tf1.Graph()
+    with g.as_default():
+        tf1.placeholder(tf.float32, [None, 2], name="x")
+        mg = json_format.MessageToJson(tf1.train.export_meta_graph())
+    with pytest.raises(ValueError, match="not a variable node"):
+        bake_nontrainable_values(mg, {"x": np.zeros(2, np.float32)})

@@ -370,12 +370,81 @@ def test_moe_all_to_all_shardmap_matches_replicated():
     assert "ep" in str(placed["block_0"]["experts_fc1"].sharding.spec)
 
 
-def test_moe_a2a_rejects_topk():
-    with pytest.raises(ValueError, match="router_top_k=1"):
-        model_from_json(build_registry_spec(
-            "transformer_moe_lm", vocab_size=10, num_experts=4,
-            router_top_k=2, ep_axis="ep", hidden=8, num_layers=1,
-            num_heads=2, mlp_dim=16, max_len=4))
+def test_moe_a2a_top2_matches_gspmd_top2():
+    """The all_to_all dispatch at router_top_k=2 must match the GSPMD
+    capacity-dispatch model with the same weights (capacity covers every
+    choice, so neither form drops tokens)."""
+    from sparkflow_tpu.parallel.ep import (make_moe_shardmap_train_step,
+                                           place_moe_params)
+
+    mesh = make_mesh({"ep": 8})
+    kw = dict(vocab_size=40, num_experts=8, moe_every=1, hidden=32,
+              num_layers=2, num_heads=4, mlp_dim=64, max_len=16,
+              dropout=0.0, capacity_factor=8.0, router_top_k=2)
+    m_a2a = model_from_json(build_registry_spec("transformer_moe_lm",
+                                                ep_axis="ep", **kw))
+    m_ref = model_from_json(build_registry_spec("transformer_moe_lm", **kw))
+    params = m_ref.init(jax.random.PRNGKey(0))
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 40, (16, 16)), jnp.int32)
+    mask = jnp.ones((16, 16), jnp.float32)
+
+    opt = build_optimizer("gradient_descent", 0.05, None)
+    placed = place_moe_params(m_a2a, jax.tree.map(jnp.copy, params), mesh)
+    step = make_moe_shardmap_train_step(m_a2a, opt, mesh)
+    p2, _, loss = step(placed, opt.init(placed), ids, mask,
+                       jax.random.PRNGKey(1))
+
+    ref_loss = m_ref.loss_vector(
+        params, {"input_ids": ids, "attention_mask": mask},
+        train=False).mean()
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    # the one-step update matches the replicated model's update too
+    import optax
+    g = jax.grad(lambda p: m_ref.loss_vector(
+        p, {"input_ids": ids, "attention_mask": mask},
+        train=False).mean())(params)
+    sgd = optax.apply_updates(params, jax.tree.map(lambda x: -0.05 * x, g))
+    for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(sgd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_moe_a2a_overflow_fraction_metric():
+    """return_overflow reports the dropped fraction: generous capacity -> 0;
+    a starved capacity_factor must drop a nonzero fraction of choices."""
+    from functools import partial
+
+    from jax import shard_map
+    from sparkflow_tpu.ops.moe_dispatch import all_to_all_moe_ffn
+
+    mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    e, h, m = 4, 8, 16
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 4, h), jnp.float32)
+    router = jnp.asarray(rs.randn(h, e), jnp.float32)
+    fc1 = jnp.asarray(rs.randn(e, h, m) * 0.1, jnp.float32)
+    b1 = jnp.zeros((e, m), jnp.float32)
+    fc2 = jnp.asarray(rs.randn(e, m, h) * 0.1, jnp.float32)
+    b2 = jnp.zeros((e, h), jnp.float32)
+
+    def run(cf):
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+                 out_specs=(P("ep"), P("ep"), P("ep")),
+                 check_vma=False)
+        def f(x, router, fc1, b1, fc2, b2):
+            y, aux, ovf = all_to_all_moe_ffn(
+                x, router, fc1, b1, fc2, b2, "ep", e, capacity_factor=cf,
+                top_k=2, return_overflow=True)
+            return y, aux[None], ovf[None]
+        return f(x, router, fc1, b1, fc2, b2)
+
+    _, _, ovf_generous = run(float(e))
+    assert float(jnp.max(ovf_generous)) == 0.0
+    _, _, ovf_tight = run(0.25)
+    assert float(jnp.mean(ovf_tight)) > 0.05
 
 
 def test_moe_a2a_outside_shardmap_fails_actionably():
