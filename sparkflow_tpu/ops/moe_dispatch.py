@@ -43,10 +43,15 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
       return_overflow  also return the fraction of live routed choices this
                        device DROPPED for lack of send-buffer capacity
 
-    Returns ``(combined [B_local, S, H], aux_loss scalar-per-device)`` — plus
-    the overflow fraction when requested. The aux loss is the Switch
-    load-balance term over LOCAL tokens; callers typically ``pmean`` it
-    across the axis.
+    Returns ``(combined [B_local, S, H], aux_loss scalar)`` — plus the
+    overflow fraction when requested. The aux loss is the Switch
+    load-balance term computed from GLOBALLY psummed routing statistics
+    (first-choice counts, router probabilities, live-token count) over
+    ``axis_name``, so it is identical on every device and bit-matches the
+    single-device computation over the full batch — mean-of-per-shard-aux
+    would not (mean of products != product of means), and the mismatch,
+    while tiny in the loss, becomes a full ±lr parameter delta once Adam
+    normalizes the gradient.
     """
     try:
         n = jax.lax.axis_size(axis_name)
@@ -82,10 +87,14 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
             if token_mask is not None else jnp.ones((nl,), jnp.float32))
 
     onehot1 = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32) * live[:, None]
-    aux = e * jnp.sum((jnp.sum(onehot1, axis=0)
-                       / jnp.maximum(jnp.sum(live), 1.0))
-                      * (jnp.sum(probs * live[:, None], axis=0)
-                         / jnp.maximum(jnp.sum(live), 1.0)))
+    # global routing statistics: psum the per-expert first-choice counts,
+    # the per-expert probability mass, and the live-token count across the
+    # axis BEFORE forming the load-balance product (see docstring)
+    count1_g = jax.lax.psum(jnp.sum(onehot1, axis=0), axis_name)      # [E]
+    pmass_g = jax.lax.psum(jnp.sum(probs * live[:, None], axis=0),
+                           axis_name)                                  # [E]
+    nlive_g = jnp.maximum(jax.lax.psum(jnp.sum(live), axis_name), 1.0)
+    aux = e * jnp.sum((count1_g / nlive_g) * (pmass_g / nlive_g))
 
     # destination peer per (choice, token), positions via cumsum over the
     # choice-major stack: ALL first choices claim send-buffer slots before

@@ -45,19 +45,30 @@ _PEAK_BF16_TFLOPS = (
 _DEFAULT_TPU_PEAK = 197.0
 
 
-def device_peak_flops() -> Optional[float]:
+def device_peak_flops(return_assumed: bool = False):
     """Peak bf16 FLOP/s of the first device, or None off-TPU (an MFU against
-    a CPU 'peak' would be noise, not signal)."""
+    a CPU 'peak' would be noise, not signal).
+
+    With ``return_assumed=True`` returns ``(peak, assumed)`` where
+    ``assumed`` is True when the device kind matched no table entry and the
+    v5e default was guessed — an unrecognized faster chip would otherwise
+    report a silently wrong (possibly >1) MFU with no indication."""
     import jax
 
     dev = jax.devices()[0]
     if dev.platform != "tpu":
-        return None
+        return (None, False) if return_assumed else None
     kind = (getattr(dev, "device_kind", "") or "").lower()
     for key, tflops in _PEAK_BF16_TFLOPS:
         if key in kind:
-            return tflops * 1e12
-    return _DEFAULT_TPU_PEAK * 1e12
+            return (tflops * 1e12, False) if return_assumed else tflops * 1e12
+    import logging
+    logging.getLogger(__name__).warning(
+        "unrecognized TPU device_kind %r: assuming v5e peak (%s TFLOP/s) "
+        "for MFU — treat reported MFU as approximate", kind,
+        _DEFAULT_TPU_PEAK)
+    return ((_DEFAULT_TPU_PEAK * 1e12, True) if return_assumed
+            else _DEFAULT_TPU_PEAK * 1e12)
 
 
 def jit_flops(fn, *args) -> Optional[float]:
@@ -137,12 +148,22 @@ def attention_flops(batch: int, heads: int, seq_q: int, seq_k: int,
 
 def mfu(flops_per_sec: Optional[float],
         peak: Optional[float] = None) -> Optional[float]:
-    """Model-FLOPs utilization in [0, 1], or None when either side is
-    unknown (off-TPU, or the FLOPs count failed)."""
+    """Model-FLOPs utilization, or None when either side is unknown
+    (off-TPU, or the FLOPs count failed). Nominally in [0, 1]; a value > 1
+    means the FLOPs count or the peak table is wrong (e.g. an unrecognized
+    chip fell back to the assumed v5e peak) — warn loudly but return the
+    raw ratio so the bad input is visible rather than clamped away."""
     if flops_per_sec is None:
         return None
     if peak is None:
         peak = device_peak_flops()
     if not peak:
         return None
-    return flops_per_sec / peak
+    u = flops_per_sec / peak
+    if u > 1.0:
+        import logging
+        logging.getLogger(__name__).warning(
+            "MFU %.3f > 1: the FLOPs count or the device peak (%.0f TFLOP/s) "
+            "is wrong — check device_peak_flops()'s table against this chip",
+            u, peak / 1e12)
+    return u
