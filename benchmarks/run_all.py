@@ -140,6 +140,15 @@ def bench_bert_step(compute_dtype):
         ids, y = batch(0)
         params, state, loss = step(params, state, ids, y, jax.random.PRNGKey(0))
         jax.block_until_ready(params)
+        if jax.default_backend() == "tpu":
+            # fail LOUDLY if the perf path degraded: a kernel edit that broke
+            # the TPU tile rules would otherwise fall back silently and this
+            # number would quietly measure XLA attention instead
+            from sparkflow_tpu.ops.attention import last_attention_path
+            path = last_attention_path()
+            assert path == "pallas", (
+                f"BERT step attention traced to the {path!r} path, not the "
+                f"pallas kernel — the flash tile rules rejected this config")
         t0 = time.perf_counter()
         n_steps = 3 if QUICK else 8
         for i in range(n_steps):
@@ -236,6 +245,10 @@ def bench_flash_attention():
                  "kernel_util": round(flops / secs / peak, 4)} if peak else {})
 
     tf = _timed(lambda q: flash_attention(q, q, q, causal=True).astype(jnp.float32).sum())
+    from sparkflow_tpu.ops.attention import last_attention_path
+    assert last_attention_path() == "pallas", (
+        f"flash bench traced the {last_attention_path()!r} path — the pallas "
+        f"kernel was silently rejected for this config")
     tr = _timed(lambda q: attention_reference(q, q, q, causal=True)
                 .astype(jnp.float32).sum())
     fwd_fl = attention_flops(2, 8, S, S, 64, causal=True)
@@ -299,6 +312,10 @@ def bench_flash_long_context():
 
         tf = _timed(lambda q: flash_attention(q, q, q, causal=True)
                     .astype(jnp.float32).sum())
+        from sparkflow_tpu.ops.attention import last_attention_path
+        assert last_attention_path() == "pallas", (
+            f"long-context bench at seq {S} traced the "
+            f"{last_attention_path()!r} path, not the pallas kernel")
         tb = _timed(lambda q: _blockwise_attention(
             q, q, q, None, True, 1.0 / 8.0, block_k=512)
             .astype(jnp.float32).sum())
@@ -309,6 +326,42 @@ def bench_flash_long_context():
         if peak:
             extra["kernel_util"] = round(fl / tf / peak, 4)
         _emit("flash_attention_long_context", tb / tf, "speedup_x", extra)
+
+
+def bench_stream_vs_collect(compute_dtype):
+    """fitMode='stream' vs the collect path on the same CNN workload: the
+    native batch ring assembles fixed-shape batches concurrently with device
+    compute, so streaming examples/sec should stay within ~10% of the fused
+    in-memory fit — if it doesn't, the device is idling on host IO."""
+    from sparkflow_tpu.models import presets
+    from sparkflow_tpu.trainer import Trainer
+
+    n = 2048 if QUICK else 16384
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n)]
+    epochs = 2 if QUICK else 4
+
+    def make_trainer():
+        return Trainer(presets.cnn(), "x:0", "y:0", optimizer="adam",
+                       mini_batch_size=1024, iters=epochs,
+                       compute_dtype=compute_dtype)
+
+    tr = make_trainer()
+    tr.fit(x, y)  # compile warmup
+    collect_eps = tr.fit(x, y, init_params=tr.params).examples_per_sec
+
+    def rows():
+        for i in range(n):
+            yield (x[i], y[i])
+
+    ts = make_trainer()
+    ts.fit_stream(rows, epochs=1)  # compile warmup (per-step program)
+    stream_eps = ts.fit_stream(rows, init_params=ts.params,
+                               epochs=epochs).examples_per_sec
+    _emit("stream_vs_collect_fit", stream_eps / collect_eps, "ratio",
+          {"stream_examples_per_sec": round(stream_eps, 1),
+           "collect_examples_per_sec": round(collect_eps, 1)})
 
 
 def bench_tokenizer():
@@ -415,6 +468,7 @@ def main():
     bench_bert_step(compute_dtype)
     bench_flash_attention()
     bench_flash_long_context()
+    bench_stream_vs_collect(compute_dtype)
     bench_tokenizer()
     bench_dataplane()
 

@@ -65,6 +65,20 @@ def force_xla_attention():
         _FORCE_XLA.reset(tok)
 
 
+# Which path the most recent flash_attention TRACE took ('pallas',
+# 'blockwise', or 'reference'). Benchmarks assert this is 'pallas' after
+# compiling their TPU step: a kernel edit that breaks the tile rules would
+# otherwise fall back silently and the suite would stay green while the
+# perf path quietly degraded (the round-2 (8,128)-tile regression).
+_LAST_PATH = None
+
+
+def last_attention_path():
+    """Path taken by the most recent :func:`flash_attention` call (at trace
+    time for jitted callers): 'pallas' | 'blockwise' | 'reference' | None."""
+    return _LAST_PATH
+
+
 # ---------------------------------------------------------------------------
 # Reference (jnp) implementation — ground truth for tests + CPU fallback
 # ---------------------------------------------------------------------------
@@ -531,9 +545,11 @@ def flash_attention(q, k, v, causal: bool = False,
     # in HBM — the pallas-tuned (VMEM-sized) auto block would inflate that
     # up to 8x, so the fallbacks cap at the scan's own tuned default
     xla_block_k = min(block_k, 512)
+    global _LAST_PATH
     if _FORCE_XLA.get():
         # sharded-jit context: GSPMD can partition the blockwise path but not
         # the pallas custom call
+        _LAST_PATH = "blockwise"
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)
     # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
@@ -546,11 +562,14 @@ def flash_attention(q, k, v, causal: bool = False,
                 and d % 8 == 0)
     if not tiles_ok:
         if kv_mask is None:
+            _LAST_PATH = "reference"
             return attention_reference(q, k, v, causal, scale)
         # blockwise keeps memory bounded when it tiles; its own fallback is
         # the dense reference path with the mask honored
+        _LAST_PATH = "blockwise"
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)
+    _LAST_PATH = "pallas"
     return _flash(q, k, v, kv_mask, causal, scale, block_q, block_k,
                   bwd_block_q, bwd_block_k, interpret)
 

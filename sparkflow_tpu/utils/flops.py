@@ -44,6 +44,8 @@ _PEAK_BF16_TFLOPS = (
 # obscures the device kind, assume v5e rather than reporting no MFU.
 _DEFAULT_TPU_PEAK = 197.0
 
+_WARNED_ASSUMED = False
+
 
 def device_peak_flops(return_assumed: bool = False):
     """Peak bf16 FLOP/s of the first device, or None off-TPU (an MFU against
@@ -62,11 +64,14 @@ def device_peak_flops(return_assumed: bool = False):
     for key, tflops in _PEAK_BF16_TFLOPS:
         if key in kind:
             return (tflops * 1e12, False) if return_assumed else tflops * 1e12
-    import logging
-    logging.getLogger(__name__).warning(
-        "unrecognized TPU device_kind %r: assuming v5e peak (%s TFLOP/s) "
-        "for MFU — treat reported MFU as approximate", kind,
-        _DEFAULT_TPU_PEAK)
+    global _WARNED_ASSUMED
+    if not _WARNED_ASSUMED:  # once per process, not once per bench entry
+        _WARNED_ASSUMED = True
+        import logging
+        logging.getLogger(__name__).warning(
+            "unrecognized TPU device_kind %r: assuming v5e peak (%s TFLOP/s) "
+            "for MFU — treat reported MFU as approximate", kind,
+            _DEFAULT_TPU_PEAK)
     return ((_DEFAULT_TPU_PEAK * 1e12, True) if return_assumed
             else _DEFAULT_TPU_PEAK * 1e12)
 

@@ -137,6 +137,30 @@ def test_force_xla_attention_skips_pallas(monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_last_attention_path_instrumentation():
+    """Benchmarks assert the perf path via last_attention_path(); pin that
+    the recorder distinguishes pallas / blockwise / reference routing."""
+    import jax.numpy as jnp
+    from sparkflow_tpu.ops import attention as A
+
+    if A.pltpu is None:
+        pytest.skip("pallas tpu backend unimportable in this build")
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 128, 8), jnp.float32)
+
+    A.flash_attention(q, q, q, interpret=True)  # tiling-eligible
+    assert A.last_attention_path() == "pallas"
+
+    with A.force_xla_attention():
+        A.flash_attention(q, q, q)
+    assert A.last_attention_path() == "blockwise"
+
+    # odd head_dim breaks the d % 8 tile rule -> dense reference fallback
+    qo = jnp.asarray(rs.randn(1, 1, 128, 6), jnp.float32)
+    A.flash_attention(qo, qo, qo)
+    assert A.last_attention_path() == "reference"
+
+
 def test_flash_bwd_nonuniform_cotangent(qkv):
     """The pallas backward kernels (dq/dk/dv) under a structured cotangent —
     uniform .sum() grads can hide transposition errors."""
