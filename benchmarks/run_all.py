@@ -364,6 +364,66 @@ def bench_stream_vs_collect(compute_dtype):
            "collect_examples_per_sec": round(collect_eps, 1)})
 
 
+def bench_quantized_inference():
+    """int8 serving vs f32 on a wide MLP (the shape quantized serving is
+    for: weight-HBM-bound batch inference). TPU-only, amortized timing —
+    one scan over fresh pre-staged batches per mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.graph_utils import build_graph
+    from sparkflow_tpu.graphdef import GraphModel
+    import sparkflow_tpu.nn as nn_
+
+    if jax.default_backend() != "tpu":
+        _emit("int8_inference_vs_f32", 0, "speedup_x", {"skipped": "not on tpu"})
+        return
+
+    def wide_mlp():
+        x = nn_.placeholder([None, 1024], name="x")
+        h = nn_.dense(x, 4096, activation="relu")
+        h = nn_.dense(h, 4096, activation="relu")
+        h = nn_.dense(h, 4096, activation="relu")
+        nn_.dense(h, 16, name="out")
+
+    model = GraphModel.from_json(build_graph(wide_mlp))
+    params = model.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    B, ITERS = 256, 16
+
+    def timed(p):
+        @jax.jit
+        def many(xs):
+            def body(acc, xb):
+                out = model.apply(p, {"x": xb}, ["out:0"])["out:0"]
+                return acc + out.astype(jnp.float32).sum(), None
+            tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), xs)
+            return tot
+
+        def fresh():
+            return jax.block_until_ready(jnp.asarray(
+                rs.rand(ITERS, B, 1024), jnp.float32))
+        float(many(fresh()))  # compile + warm
+        inp = fresh()
+        t0 = time.perf_counter()
+        float(many(inp))
+        return (time.perf_counter() - t0) / ITERS
+
+    t_f32 = timed(params)
+    results = {}
+    for mode in ("weight_only", "dynamic"):
+        qp = model.quantize_for_serving(params, mode=mode)
+        try:
+            results[mode] = timed(qp)
+        finally:
+            model.quant_mode = None
+    _emit("int8_inference_vs_f32", t_f32 / results["weight_only"], "speedup_x",
+          {"batch": B, "f32_ms": round(t_f32 * 1e3, 2),
+           "weight_only_ms": round(results["weight_only"] * 1e3, 2),
+           "dynamic_ms": round(results["dynamic"] * 1e3, 2),
+           "dynamic_speedup_x": round(t_f32 / results["dynamic"], 2)})
+
+
 def bench_tokenizer():
     """Native C++ WordPiece vs the python fallback — measurable on any host
     (no TPU involved): strings/sec on synthetic text."""
@@ -469,6 +529,7 @@ def main():
     bench_flash_attention()
     bench_flash_long_context()
     bench_stream_vs_collect(compute_dtype)
+    bench_quantized_inference()
     bench_tokenizer()
     bench_dataplane()
 

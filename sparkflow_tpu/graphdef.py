@@ -209,14 +209,17 @@ _TF_ACT_SCOPE = {
 class _EvalCtx:
     """Per-apply context threaded through op evaluation."""
 
-    __slots__ = ("params", "feeds", "train", "rng", "compute_dtype")
+    __slots__ = ("params", "feeds", "train", "rng", "compute_dtype",
+                 "quant_mode")
 
-    def __init__(self, params, feeds, train, rng, compute_dtype):
+    def __init__(self, params, feeds, train, rng, compute_dtype,
+                 quant_mode=None):
         self.params = params
         self.feeds = feeds
         self.train = train
         self.rng = rng
         self.compute_dtype = compute_dtype
+        self.quant_mode = quant_mode
 
     def next_rng(self):
         if self.rng is None:
@@ -257,6 +260,11 @@ def _params_dense(node, ins):
 
 def _eval_dense(node, ins, ctx, p):
     x = _cast(ins[0], ctx.compute_dtype)
+    if "kernel_q8" in p:  # int8-quantized serving tree (utils/quant.py)
+        from .utils.quant import quantized_dense
+        return _cast(quantized_dense(x, p, ctx.quant_mode or "weight_only",
+                                     compute_dtype=ctx.compute_dtype),
+                     ctx.compute_dtype)
     k = _cast(p["kernel"], ctx.compute_dtype)
     # same-dtype operands keep the VJP well-typed; with bf16 compute the TPU
     # MXU still accumulates in f32 internally. Without a compute dtype, ask
@@ -298,7 +306,12 @@ def _params_conv2d(node, ins):
 
 def _eval_conv2d(node, ins, ctx, p):
     x = _cast(ins[0], ctx.compute_dtype)
-    k = _cast(p["kernel"], ctx.compute_dtype)
+    if "kernel_q8" in p:  # conv always serves weight-only (see utils/quant.py)
+        from .utils.quant import dequantize_tensor
+        k = _cast(dequantize_tensor(p["kernel_q8"], p["kernel_scale"]),
+                  ctx.compute_dtype)
+    else:
+        k = _cast(p["kernel"], ctx.compute_dtype)
     sh, sw = _pair(node.attrs.get("strides", 1))
     pad = node.attrs.get("padding", "VALID").upper()
     y = jax.lax.conv_general_dilated(
@@ -619,6 +632,10 @@ class GraphModel:
     def __init__(self, graphdef: GraphDef, compute_dtype: Optional[Any] = None):
         self.graphdef = graphdef
         self.compute_dtype = compute_dtype
+        # int8 serving (utils/quant.py): apply() consumes quantized trees when
+        # present; 'dynamic' additionally routes dense matmuls through the
+        # int8 MXU path. Set via quantize_for_serving() or directly.
+        self.quant_mode: Optional[str] = None
         self._shapes: Dict[int, Shape] = {}
         self._infer_shapes()
 
@@ -684,7 +701,8 @@ class GraphModel:
         norm_feeds = {k.split(":")[0]: v for k, v in feeds.items()}
         target_ids = [o if isinstance(o, int) else self.graphdef.resolve(o)
                       for o in outputs]
-        ctx = _EvalCtx(params, norm_feeds, train, rng, self.compute_dtype)
+        ctx = _EvalCtx(params, norm_feeds, train, rng, self.compute_dtype,
+                       self.quant_mode)
         values: Dict[int, Any] = {}
         for node in self._needed(target_ids):
             od = OPS[node.op]
@@ -694,6 +712,17 @@ class GraphModel:
             else:
                 values[node.id] = od.eval(node, ins, ctx)
         return {o: values[t] for o, t in zip(outputs, target_ids)}
+
+    def quantize_for_serving(self, params, mode: str = "weight_only",
+                             min_size: int = 4096):
+        """int8-quantize a trained params tree for inference and set this
+        model to serve it (``utils/quant.py``). Returns the quantized tree;
+        training must keep the original full-precision params."""
+        from .utils.quant import MODES, quantize_params
+        if mode not in MODES:
+            raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
+        self.quant_mode = mode
+        return quantize_params(params, min_size=min_size)
 
     def loss_vector(self, params, feeds: Dict[str, Any], train: bool = True,
                     rng=None) -> jax.Array:

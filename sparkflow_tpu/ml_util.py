@@ -83,17 +83,21 @@ _PREDICT_CACHE_MAX = 32
 
 
 def _cached_predict_fn(graph_json: str, tf_output: str, tf_input,
-                       tf_dropout: Optional[str], dropout_value: float):
+                       tf_dropout: Optional[str], dropout_value: float,
+                       quantize: Optional[str] = None):
     """Cache (model, predict_fn) across partitions — the reference rebuilt the
     whole session per partition (``ml_util.py:61-68``); one compiled program
-    serves all partitions here."""
+    serves all partitions here. ``quantize`` ('weight_only'/'dynamic') keys
+    separately: the quantized program has a different params signature."""
     digest = hashlib.sha256(graph_json.encode()).hexdigest()
     in_key = (tuple(tf_input) if isinstance(tf_input, (list, tuple))
               else tf_input)
-    key = (digest, tf_output, in_key, tf_dropout, dropout_value)
+    key = (digest, tf_output, in_key, tf_dropout, dropout_value, quantize)
     if key not in _PREDICT_CACHE:
         from .models import model_from_json
         model = model_from_json(graph_json)
+        if quantize:
+            model.quant_mode = quantize
         fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)
         _PREDICT_CACHE[key] = (model, fn)
         while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
@@ -103,15 +107,49 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input,
     return _PREDICT_CACHE[key]
 
 
+# quantized weight trees, keyed on (weights digest, mode): quantizing the
+# full tree per partition would undo the very amortization _PREDICT_CACHE
+# exists for (the reference rebuilt its session per partition)
+_QUANT_CACHE: "OrderedDict[Tuple[str, str], Any]" = OrderedDict()
+_QUANT_CACHE_MAX = 8
+
+
+def _cached_quantized_params(model, graph_weights: str, quantize: str):
+    from .graphdef import GraphModel
+    from .utils.quant import MODES, quantize_params
+
+    if quantize not in MODES:
+        # validate HERE too: spark_async checks driver-side, but predict_func
+        # is a documented serving API of its own — a typo'd mode must not
+        # silently serve a different path
+        raise ValueError(f"quantize must be one of {MODES}, got {quantize!r}")
+    if not isinstance(model, GraphModel):
+        raise ValueError(
+            f"int8 serving (inferenceQuantize) currently supports graphdef "
+            f"models (the nn DSL / build_graph); got {type(model).__name__} — "
+            f"serve this model without quantization")
+    key = (hashlib.sha256(graph_weights.encode()).hexdigest(), quantize)
+    if key not in _QUANT_CACHE:
+        params = list_to_params(model, resolve_weights(graph_weights))
+        _QUANT_CACHE[key] = quantize_params(params)
+        while len(_QUANT_CACHE) > _QUANT_CACHE_MAX:
+            _QUANT_CACHE.popitem(last=False)
+    else:
+        _QUANT_CACHE.move_to_end(key)
+    return _QUANT_CACHE[key]
+
+
 def predict_func(rows: Iterable, graph_json: str, prediction: str,
                  graph_weights: str, inp: str, activation: str, tf_input: str,
                  tf_dropout: Optional[str] = None, to_keep_dropout: bool = False,
                  chunk_size: int = 4096, extra_cols: Optional[List[str]] = None,
-                 extra_inputs: Optional[List[str]] = None) -> List:
+                 extra_inputs: Optional[List[str]] = None,
+                 quantize: Optional[str] = None) -> List:
     """Per-partition inference (same signature/meaning as
     ``sparkflow/ml_util.py:54``). ``activation`` is the output tensor name.
     ``extra_cols``/``extra_inputs`` feed additional columns to additional
-    tensors (multi-input models, e.g. an attention mask)."""
+    tensors (multi-input models, e.g. an attention mask). ``quantize``
+    serves int8 weights ('weight_only' or 'dynamic', ``utils/quant.py``)."""
     if bool(extra_cols) != bool(extra_inputs) or (
             extra_cols and len(extra_cols) != len(extra_inputs)):
         raise ValueError("extra_cols and extra_inputs must pair up one-to-one")
@@ -121,8 +159,11 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
     dropout_v = 1.0 if (tf_dropout is not None and to_keep_dropout) else 0.0
     names = [tf_input] + list(extra_inputs) if extra_cols else tf_input
     model, fn = _cached_predict_fn(graph_json, activation, names,
-                                   tf_dropout, dropout_v)
-    params = list_to_params(model, resolve_weights(graph_weights))
+                                   tf_dropout, dropout_v, quantize)
+    if quantize:
+        params = _cached_quantized_params(model, graph_weights, quantize)
+    else:
+        params = list_to_params(model, resolve_weights(graph_weights))
     cols = [inp] + list(extra_cols) if extra_cols else [inp]
     stacked = tuple(
         np.stack([vector_to_array(rd[c]) for rd in row_dicts]).astype(np.float32)

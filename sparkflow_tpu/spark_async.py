@@ -94,6 +94,10 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
     # plain strings (persistence-friendly, like every reference Param)
     extraInputCols = Param(Params._dummy(), "extraInputCols", "", typeConverter=TypeConverters.toString)
     extraTfInputs = Param(Params._dummy(), "extraTfInputs", "", typeConverter=TypeConverters.toString)
+    # upgrade: int8-quantized serving ('' = off, 'weight_only', 'dynamic');
+    # weights stay full-precision in the persisted Params — quantization
+    # happens executor-side at serve time (utils/quant.py)
+    inferenceQuantize = Param(Params._dummy(), "inferenceQuantize", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -106,12 +110,14 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                  toKeepDropout=None,
                  predictionCol=None,
                  extraInputCols=None,
-                 extraTfInputs=None):
+                 extraTfInputs=None,
+                 inferenceQuantize=None):
         super(SparkAsyncDLModel, self).__init__()
         self._setDefault(modelJson=None, inputCol='encoded',
                          predictionCol='predicted', tfOutput=None, tfInput=None,
                          modelWeights=None, tfDropout=None, toKeepDropout=False,
-                         extraInputCols=None, extraTfInputs=None)
+                         extraInputCols=None, extraTfInputs=None,
+                         inferenceQuantize=None)
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -126,7 +132,8 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                   toKeepDropout=None,
                   predictionCol=None,
                   extraInputCols=None,
-                  extraTfInputs=None):
+                  extraTfInputs=None,
+                  inferenceQuantize=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -145,11 +152,19 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
             raise ValueError(
                 "extraInputCols (%d names) and extraTfInputs (%d names) must "
                 "pair up one-to-one" % (len(extra_cols), len(extra_inputs)))
+        quantize = _opt_param(self, self.inferenceQuantize) or None
+        if quantize:
+            from .utils.quant import MODES
+            if quantize not in MODES:
+                raise ValueError(
+                    "inferenceQuantize must be one of %s (or unset), got %r"
+                    % (list(MODES), quantize))
         return dataset.rdd.mapPartitions(
             lambda x: predict_func(x, mod_json, out, mod_weights, inp, tf_output,
                                    tf_input, tf_dropout, to_keep_dropout,
                                    extra_cols=extra_cols or None,
-                                   extra_inputs=extra_inputs or None)).toDF()
+                                   extra_inputs=extra_inputs or None,
+                                   quantize=quantize)).toDF()
 
 
 class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,

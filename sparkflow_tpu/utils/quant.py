@@ -1,0 +1,141 @@
+"""int8 weight quantization for TPU inference.
+
+A serving-side capability beyond the reference (which serves f32 through
+``tf.Session``, ``sparkflow/ml_util.py:65-73``): quantize a trained params
+tree to symmetric per-output-channel int8 and serve it through the same
+``apply``/``predict_func`` paths. Two modes, both TPU-motivated:
+
+- ``weight_only``: kernels stored int8 + per-channel f32 scale, dequantized
+  to the compute dtype at the matmul. Halves the weight HBM traffic vs
+  bf16 (4x vs f32) — the win for bandwidth-bound serving — with activations
+  untouched, so accuracy loss is just the 8-bit weight rounding.
+- ``dynamic``: activations additionally quantized per-row at runtime
+  (dynamic absmax), and the matmul runs int8 x int8 -> int32 on the MXU's
+  int8 path (2x the bf16 peak on v5e: 394 TOPS) before rescaling by
+  ``row_scale x channel_scale``.
+
+Quantization happens AFTER training/deserialization, on the serving side
+(``quantize_params``); the stored model stays full-precision, so the wire
+format (weights JSON / npz) and training are untouched.
+
+The quantized tree swaps each selected ``kernel`` leaf for
+``kernel_q8`` (int8) + ``kernel_scale`` (f32 per output channel); the
+graphdef ``dense``/``conv2d`` evals check for the ``_q8`` form, so the whole
+GraphModel serving surface (predict_func, SparkAsyncDLModel.transform,
+predict_in_chunks) serves quantized trees unchanged. Conv kernels always
+serve weight-only (int8 conv dot-generals lower poorly; the dequant fuses
+into the conv anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+MODES = ("weight_only", "dynamic")
+
+
+def quantize_tensor(w, axis: int = -1):
+    """Symmetric per-channel int8: returns ``(q8, scale)`` with
+    ``q8 * scale ~= w``; ``scale`` keeps ``w``'s rank with size-1 axes
+    everywhere except ``axis`` (broadcasts back without reshapes)."""
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(a for a in range(w.ndim) if a != (axis % w.ndim))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_tensor(q8, scale, dtype=jnp.float32):
+    return (q8.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_matmul(x, q8, scale):
+    """``x @ dequant(q8)`` with the contraction in int8 x int8 -> int32.
+
+    ``x`` [..., K] float; ``q8`` [K, N] int8; ``scale`` [1, N] (or [N]) f32.
+    Activations quantize per-row (dynamic absmax over K). The int32
+    accumulator rescales by ``row_scale * channel_scale``.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)        # [..., 1]
+    xs = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, q8, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                        # [..., N]
+    return acc.astype(jnp.float32) * xs * jnp.reshape(scale, (1,) * (acc.ndim - 1) + (-1,))
+
+
+def quantized_dense(x, layer_params, mode: str = "weight_only",
+                    compute_dtype=None):
+    """Dense matmul over a possibly-quantized layer dict. Returns None when
+    the layer is NOT quantized (caller runs its normal path). The mode is a
+    property of the serving model (``quant_mode``), not the tree — the same
+    quantized tree serves either mode."""
+    if not isinstance(layer_params, dict) or "kernel_q8" not in layer_params:
+        return None
+    q8 = layer_params["kernel_q8"]
+    scale = layer_params["kernel_scale"]
+    if mode == "dynamic" and q8.ndim == 2:
+        y = int8_matmul(x, q8, scale)
+    else:
+        k = dequantize_tensor(q8, scale,
+                              compute_dtype or jnp.result_type(x, jnp.float32))
+        y = jnp.matmul(x.astype(k.dtype), k)
+    if "bias" in layer_params:
+        y = y + layer_params["bias"].astype(y.dtype)
+    return y
+
+
+def _is_matmul_kernel(path_leaf: str, arr) -> bool:
+    return path_leaf == "kernel" and getattr(arr, "ndim", 0) == 2
+
+
+def _is_conv_kernel(path_leaf: str, arr) -> bool:
+    return path_leaf == "kernel" and getattr(arr, "ndim", 0) == 4
+
+
+def quantize_params(params: Dict[str, Dict[str, Any]],
+                    min_size: int = 4096) -> Dict[str, Dict[str, Any]]:
+    """Quantize every dense/conv ``kernel`` leaf with >= ``min_size`` elements
+    (small layers aren't worth the rounding) in a nested-dict params tree —
+    the shape both GraphModel and the registry models use. Non-kernel leaves
+    (biases, norms, embeddings) pass through untouched.
+
+    The quantized tree is mode-agnostic; the serving model's ``quant_mode``
+    ('weight_only' | 'dynamic') picks the matmul path. Conv kernels always
+    serve weight-only.
+    """
+
+    def qlayer(layer):
+        if not isinstance(layer, dict):
+            return layer
+        out = {}
+        for name, arr in layer.items():
+            if isinstance(arr, dict):
+                out[name] = qlayer(arr)
+                continue
+            size = int(np_size(arr))
+            if ((_is_matmul_kernel(name, arr) or _is_conv_kernel(name, arr))
+                    and size >= min_size):
+                q8, scale = quantize_tensor(arr, axis=-1)  # per out-channel
+                out["kernel_q8"] = q8
+                out["kernel_scale"] = scale
+            else:
+                out[name] = arr
+        return out
+
+    return {k: qlayer(v) for k, v in params.items()}
+
+
+def np_size(arr) -> int:
+    try:
+        return int(arr.size)
+    except Exception:
+        import numpy as np
+
+        return int(np.asarray(arr).size)

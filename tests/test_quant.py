@@ -1,0 +1,205 @@
+"""int8 quantized serving (utils/quant.py + graphdef integration).
+
+The reference serves f32 through tf.Session (sparkflow/ml_util.py:65-73);
+quantized serving is a TPU-era capability upgrade: same predict surface,
+int8 weights. These tests pin the numerics contract (quantized predictions
+track full-precision ones) and the estimator-level wiring.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.graphdef import GraphModel
+from sparkflow_tpu.trainer import Trainer
+from sparkflow_tpu.core import make_predict_fn, predict_in_chunks
+from sparkflow_tpu.utils.quant import (dequantize_tensor, int8_matmul,
+                                       quantize_params, quantize_tensor)
+
+
+def _mlp():
+    x = nn.placeholder([None, 32], name="x")
+    y = nn.placeholder([None, 4], name="y")
+    h = nn.dense(x, 64, activation="relu")
+    out = nn.dense(h, 4, name="out")
+    nn.softmax_cross_entropy(y, out)
+
+
+def _cnn():
+    x = nn.placeholder([None, 64], name="x")
+    y = nn.placeholder([None, 3], name="y")
+    xr = nn.reshape(x, [-1, 8, 8, 1])
+    c = nn.conv2d(xr, 16, 3, activation="relu")
+    out = nn.dense(nn.flatten(c), 3, name="out")
+    nn.softmax_cross_entropy(y, out)
+
+
+def test_quantize_tensor_roundtrip_error_bound():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(64, 32) * 0.3, jnp.float32)
+    q8, scale = quantize_tensor(w, axis=-1)
+    assert q8.dtype == jnp.int8
+    deq = dequantize_tensor(q8, scale)
+    # symmetric rounding: error <= scale/2 elementwise, per output channel
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    assert np.all(err <= np.asarray(scale)[0] / 2 + 1e-7)
+    # zero column stays exactly zero with a benign scale
+    wz = w.at[:, 3].set(0.0)
+    q8z, sz = quantize_tensor(wz, axis=-1)
+    assert float(jnp.max(jnp.abs(dequantize_tensor(q8z, sz)[:, 3]))) == 0.0
+
+
+def test_int8_matmul_tracks_f32():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(16, 48), jnp.float32)
+    w = jnp.asarray(rs.randn(48, 24) * 0.2, jnp.float32)
+    q8, scale = quantize_tensor(w, axis=-1)
+    ref = x @ w
+    got = int8_matmul(x, q8, scale)
+    # int8 x int8 with dynamic per-row activation scales: ~1% relative on
+    # the matmul's output scale
+    tol = 0.02 * float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(got - ref))) < tol
+
+
+def test_quantize_params_selects_by_size_and_name():
+    model = GraphModel.from_json(build_graph(_mlp))
+    params = model.init(jax.random.PRNGKey(0))
+    q = quantize_params(params, min_size=1024)
+    # 32x64 = 2048 quantizes; 64x4 = 256 stays full precision
+    assert "kernel_q8" in q["dense/BiasAdd"] and "kernel" not in q["dense/BiasAdd"]
+    assert q["dense/BiasAdd"]["kernel_q8"].dtype == jnp.int8
+    assert "kernel" in q["out/BiasAdd"] and "kernel_q8" not in q["out/BiasAdd"]
+    # biases untouched
+    assert q["dense/BiasAdd"]["bias"].dtype == jnp.float32
+
+
+@pytest.mark.parametrize("mode", ["weight_only", "dynamic"])
+def test_graphmodel_quantized_predictions_track_f32(mode):
+    rs = np.random.RandomState(2)
+    x = rs.rand(256, 32).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 256)]
+    tr = Trainer(build_graph(_mlp), "x:0", "y:0", iters=5, mini_batch_size=64)
+    tr.fit(x, y)
+
+    model = tr.model
+    fp = np.asarray(predict_in_chunks(
+        make_predict_fn(model, "x:0", "out:0"), tr.params, x))
+
+    qparams = model.quantize_for_serving(tr.params, mode=mode, min_size=256)
+    try:
+        qp = np.asarray(predict_in_chunks(
+            make_predict_fn(model, "x:0", "out:0"), qparams, x))
+    finally:
+        model.quant_mode = None
+    # logits track within a small fraction of their dynamic range, and the
+    # served class decisions overwhelmingly agree
+    tol = 0.05 * (fp.max() - fp.min() + 1e-6)
+    assert np.abs(qp - fp).max() < tol
+    agree = (qp.argmax(axis=1) == fp.argmax(axis=1)).mean()
+    # near-tie logits may legitimately flip under 8-bit rounding
+    assert agree >= 0.98
+
+
+def test_conv_kernel_quantizes_weight_only():
+    rs = np.random.RandomState(3)
+    x = rs.rand(64, 64).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+    tr = Trainer(build_graph(_cnn), "x:0", "y:0", iters=2, mini_batch_size=32)
+    tr.fit(x, y)
+    model = tr.model
+    fp = np.asarray(predict_in_chunks(
+        make_predict_fn(model, "x:0", "out:0"), tr.params, x))
+    qparams = model.quantize_for_serving(tr.params, mode="dynamic", min_size=64)
+    try:
+        assert "kernel_q8" in qparams[[k for k in qparams if k.startswith("conv2d")][0]]
+        qp = np.asarray(predict_in_chunks(
+            make_predict_fn(model, "x:0", "out:0"), qparams, x))
+    finally:
+        model.quant_mode = None
+    tol = 0.05 * (fp.max() - fp.min() + 1e-6)
+    assert np.abs(qp - fp).max() < tol
+
+
+def test_quantize_for_serving_rejects_bad_mode():
+    model = GraphModel.from_json(build_graph(_mlp))
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="weight_only"):
+        model.quantize_for_serving(params, mode="int4")
+
+
+def test_predict_func_rejects_bad_mode_and_non_graphdef_models():
+    """Serving-side validation (predict_func is its own documented API):
+    a typo'd mode must not silently serve a different path, and model types
+    without a _q8 eval path must refuse rather than silently serve f32."""
+    from sparkflow_tpu.ml_util import _cached_quantized_params
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+
+    gm = GraphModel.from_json(build_graph(_mlp))
+    with pytest.raises(ValueError, match="weight_only"):
+        _cached_quantized_params(gm, "[]", "dyanmic")  # typo
+
+    reg = model_from_json(build_registry_spec(
+        "transformer_classifier", vocab_size=50, num_classes=2, hidden=16,
+        num_layers=1, num_heads=2, mlp_dim=32, max_len=8))
+    with pytest.raises(ValueError, match="graphdef"):
+        _cached_quantized_params(reg, "[]", "weight_only")
+
+
+def test_quantized_dense_respects_compute_dtype():
+    """Weight-only serving on a bf16 model must run the matmul in bf16 —
+    an f32 fallback would halve the MXU rate and double activation traffic."""
+    from sparkflow_tpu.utils.quant import quantized_dense
+
+    rs = np.random.RandomState(5)
+    w = jnp.asarray(rs.randn(32, 16) * 0.2, jnp.float32)
+    q8, scale = quantize_tensor(w)
+    layer = {"kernel_q8": q8, "kernel_scale": scale}
+    x = jnp.asarray(rs.randn(4, 32), jnp.bfloat16)
+    y = quantized_dense(x, layer, "weight_only", compute_dtype=jnp.bfloat16)
+    assert y.dtype == jnp.bfloat16
+
+
+def test_estimator_inference_quantize_end_to_end():
+    """inferenceQuantize Param: transform serves int8 with predictions
+    tracking the f32 transform of the same fitted model."""
+    from sparkflow_tpu.localml import LocalSession, Vectors
+    from sparkflow_tpu.spark_async import SparkAsyncDL
+
+    def model():
+        x = nn.placeholder([None, 2], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        h = nn.dense(x, 64, activation="relu")
+        h = nn.dense(h, 64, activation="relu")  # 64x64: crosses min_size=4096
+        out = nn.dense(h, 1, activation="sigmoid", name="outer")
+        nn.sigmoid_cross_entropy(y, out)
+
+    spark = LocalSession.builder.appName("quant-test").getOrCreate()
+    rs = np.random.RandomState(4)
+    rows = []
+    for _ in range(100):
+        rows.append((1.0, Vectors.dense(rs.normal(2, 1, 2))))
+        rows.append((0.0, Vectors.dense(rs.normal(-2, 1, 2))))
+    df = spark.createDataFrame(rows, ["label", "features"])
+
+    est = SparkAsyncDL(
+        inputCol="features", tensorflowGraph=build_graph(model),
+        tfInput="x:0", tfLabel="y:0", tfOutput="outer/Sigmoid:0",
+        labelCol="label", tfLearningRate=.1, iters=10, miniBatchSize=64,
+        verbose=0)
+    fitted = est.fit(df)
+
+    base = [float(r["predicted"]) for r in fitted.transform(df).collect()]
+    fitted.setParams(inferenceQuantize="weight_only")
+    quant = [float(r["predicted"]) for r in fitted.transform(df).collect()]
+    agree = np.mean([round(a) == round(b) for a, b in zip(base, quant)])
+    assert agree >= 0.98
+    assert np.max(np.abs(np.asarray(base) - np.asarray(quant))) < 0.05
+
+    fitted.setParams(inferenceQuantize="int4")
+    with pytest.raises(ValueError, match="inferenceQuantize"):
+        fitted.transform(df)
