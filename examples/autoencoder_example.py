@@ -2,6 +2,11 @@
 reference's ``examples/autoencoder_example.py``. The bottleneck activations are
 read through ``tfOutput='out/Sigmoid:0'`` exactly as in the reference."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from sparkflow_tpu import nn
 from sparkflow_tpu.graph_utils import build_graph
 from sparkflow_tpu.tensorflow_async import SparkAsyncDL

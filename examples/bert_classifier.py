@@ -8,6 +8,10 @@ kernel; CPU smoke mode shrinks the model.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

@@ -3,6 +3,10 @@
 (BASELINE.md: ≥5x reference throughput on TPU)."""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

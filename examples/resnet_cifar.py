@@ -7,6 +7,10 @@ the registry spec is the Estimator's graph Param like any other model.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

@@ -9,6 +9,10 @@ TPU-idiomatic shape for ``tf.nn.dynamic_rnn``-era models.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

@@ -6,6 +6,11 @@ the big-data ingest path that replaces the reference's per-partition Python
 loops. With pyspark, feed ``df.rdd.toLocalIterator()``.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import sparkflow_tpu.nn as nn

@@ -10,6 +10,10 @@ save+load, all through the standard Spark ML surface.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

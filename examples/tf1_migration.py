@@ -15,6 +15,10 @@ used to CREATE the fixtures, mimicking a legacy sparkflow user's assets).
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 import numpy as np
 

@@ -718,11 +718,8 @@ class GraphModel:
         """int8-quantize a trained params tree for inference and set this
         model to serve it (``utils/quant.py``). Returns the quantized tree;
         training must keep the original full-precision params."""
-        from .utils.quant import MODES, quantize_params
-        if mode not in MODES:
-            raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
-        self.quant_mode = mode
-        return quantize_params(params, min_size=min_size)
+        from .utils.quant import quantize_for_serving
+        return quantize_for_serving(self, params, mode, min_size)
 
     def loss_vector(self, params, feeds: Dict[str, Any], train: bool = True,
                     rng=None) -> jax.Array:

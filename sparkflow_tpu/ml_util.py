@@ -107,10 +107,10 @@ def _cached_predict_fn(graph_json: str, tf_output: str, tf_input,
     return _PREDICT_CACHE[key]
 
 
-# quantized weight trees, keyed on (weights digest, mode): quantizing the
+# quantized weight trees, keyed on the weights identity: quantizing the
 # full tree per partition would undo the very amortization _PREDICT_CACHE
 # exists for (the reference rebuilt its session per partition)
-_QUANT_CACHE: "OrderedDict[Tuple[str, str], Any]" = OrderedDict()
+_QUANT_CACHE: "OrderedDict[str, Any]" = OrderedDict()
 _QUANT_CACHE_MAX = 8
 
 
@@ -123,12 +123,22 @@ def _cached_quantized_params(model, graph_weights: str, quantize: str):
         # is a documented serving API of its own — a typo'd mode must not
         # silently serve a different path
         raise ValueError(f"quantize must be one of {MODES}, got {quantize!r}")
-    if not isinstance(model, GraphModel):
+    supports = (isinstance(model, GraphModel)
+                or getattr(model, "SUPPORTS_INT8_SERVING", False))
+    if not supports:
         raise ValueError(
-            f"int8 serving (inferenceQuantize) currently supports graphdef "
-            f"models (the nn DSL / build_graph); got {type(model).__name__} — "
-            f"serve this model without quantization")
-    key = (hashlib.sha256(graph_weights.encode()).hexdigest(), quantize)
+            f"int8 serving (inferenceQuantize) supports graphdef models (the "
+            f"nn DSL / build_graph) and the transformer family; got "
+            f"{type(model).__name__} — serve this model without quantization")
+    # the tree is mode-agnostic (quant.py), so the key is the weights alone;
+    # npz side-files key on (path, mtime, size) — the string digest would
+    # serve stale weights after a refit overwrites the same path
+    if graph_weights.startswith("npz:"):
+        import os as _os
+        st = _os.stat(graph_weights[4:])
+        key = f"{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
+    else:
+        key = hashlib.sha256(graph_weights.encode()).hexdigest()
     if key not in _QUANT_CACHE:
         params = list_to_params(model, resolve_weights(graph_weights))
         _QUANT_CACHE[key] = quantize_params(params)

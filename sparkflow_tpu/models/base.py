@@ -45,9 +45,15 @@ class RegistryModel:
 
     TENSORS: Sequence[str] = ()
 
+    # model families whose evals consume int8-quantized trees set this True
+    # (the transformer family); serving refuses quantized trees otherwise
+    # instead of silently computing f32
+    SUPPORTS_INT8_SERVING = False
+
     def __init__(self, compute_dtype: Optional[Any] = None):
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if isinstance(compute_dtype, str) else compute_dtype)
+        self.quant_mode: Optional[str] = None
         self.graphdef = _Names(self.TENSORS)
 
     # -- GraphModel-compatible surface ---------------------------------------
@@ -78,6 +84,17 @@ class RegistryModel:
                 layer[pname] = _initializer(init_name)(sub, shape, jnp.float32)
             params[lname] = layer
         return params
+
+    def quantize_for_serving(self, params, mode: str = "weight_only",
+                             min_size: int = 4096):
+        """int8-quantize a trained params tree for inference (families with
+        ``SUPPORTS_INT8_SERVING``; ``utils/quant.py``)."""
+        if not self.SUPPORTS_INT8_SERVING:
+            raise ValueError(
+                f"{type(self).__name__} does not support int8 serving; "
+                f"the transformer family and graphdef models do")
+        from ..utils.quant import quantize_for_serving
+        return quantize_for_serving(self, params, mode, min_size)
 
     # -- helpers --------------------------------------------------------------
 

@@ -190,12 +190,12 @@ class _MoEMixin:
             return super()._block_aux(bp, x, mask, causal, train, rng)
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-        qkv = _dense(y, bp["qkv_kernel"], bp["qkv_bias"])
+        qkv = self._proj(bp, "qkv_", y)
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
         att = self._attention(q, k, v, mask, causal)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
-        att, rng = self._dropout(_dense(att, bp["o_kernel"], bp["o_bias"]), train, rng)
+        att, rng = self._dropout(self._proj(bp, "o_", att), train, rng)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         y, aux = self._moe_mlp(bp, y, token_mask=mask)

@@ -110,6 +110,22 @@ class _TransformerBase(RegistryModel):
 
     # -- forward -------------------------------------------------------------
 
+    SUPPORTS_INT8_SERVING = True
+
+    def _proj(self, p, base, x):
+        """Dense projection through ``p[f'{base}kernel']``, consuming the
+        int8-quantized form (``{base}kernel_q8``) when the serving tree was
+        produced by ``quantize_for_serving`` (utils/quant.py). The result is
+        cast back to ``x``'s dtype: the dynamic path rescales in f32, and
+        without the cast a bf16 model's whole residual stream would silently
+        promote to f32 (double activation traffic, half MXU rate)."""
+        if f"{base}kernel_q8" in p:
+            from ..utils.quant import quantized_dense
+            return quantized_dense(x, p, self.quant_mode or "weight_only",
+                                   compute_dtype=x.dtype,
+                                   prefix=f"{base}kernel").astype(x.dtype)
+        return _dense(x, p[f"{base}kernel"], p.get(f"{base}bias"))
+
     def _dropout(self, x, train, rng):
         if not train or self.dropout <= 0.0:
             return x, rng
@@ -132,16 +148,16 @@ class _TransformerBase(RegistryModel):
     def _block(self, bp, x, mask, causal, train, rng):
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-        qkv = _dense(y, bp["qkv_kernel"], bp["qkv_bias"])
+        qkv = self._proj(bp, "qkv_", y)
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
         att = self._attention(q, k, v, mask, causal)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
-        att, rng = self._dropout(_dense(att, bp["o_kernel"], bp["o_bias"]), train, rng)
+        att, rng = self._dropout(self._proj(bp, "o_", att), train, rng)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-        y = jax.nn.gelu(_dense(y, bp["fc1_kernel"], bp["fc1_bias"]))
-        y, rng = self._dropout(_dense(y, bp["fc2_kernel"], bp["fc2_bias"]), train, rng)
+        y = jax.nn.gelu(self._proj(bp, "fc1_", y))
+        y, rng = self._dropout(self._proj(bp, "fc2_", y), train, rng)
         return x + y, rng
 
     def _block_aux(self, bp, x, mask, causal, train, rng):
@@ -214,8 +230,7 @@ class TransformerClassifier(_TransformerBase):
             pooled = jnp.sum(x * w, axis=1) / jnp.maximum(jnp.sum(w, axis=1), 1e-6)
         else:
             pooled = jnp.mean(x, axis=1)
-        logits = _dense(pooled.astype(jnp.float32), params["head"]["kernel"],
-                        params["head"]["bias"])
+        logits = self._proj(params["head"], "", pooled.astype(jnp.float32))
         return {"logits": logits,
                 "probs": jax.nn.softmax(logits, axis=-1),
                 "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}

@@ -71,32 +71,50 @@ def int8_matmul(x, q8, scale):
 
 
 def quantized_dense(x, layer_params, mode: str = "weight_only",
-                    compute_dtype=None):
+                    compute_dtype=None, prefix: str = "kernel"):
     """Dense matmul over a possibly-quantized layer dict. Returns None when
     the layer is NOT quantized (caller runs its normal path). The mode is a
     property of the serving model (``quant_mode``), not the tree — the same
-    quantized tree serves either mode."""
-    if not isinstance(layer_params, dict) or "kernel_q8" not in layer_params:
+    quantized tree serves either mode. ``prefix`` selects the kernel within
+    a multi-projection layer dict (e.g. 'qkv_kernel' in a transformer
+    block); the bias is looked up as the matching ``*bias`` name."""
+    if not isinstance(layer_params, dict) or f"{prefix}_q8" not in layer_params:
         return None
-    q8 = layer_params["kernel_q8"]
-    scale = layer_params["kernel_scale"]
+    q8 = layer_params[f"{prefix}_q8"]
+    scale = layer_params[f"{prefix}_scale"]
     if mode == "dynamic" and q8.ndim == 2:
         y = int8_matmul(x, q8, scale)
     else:
         k = dequantize_tensor(q8, scale,
                               compute_dtype or jnp.result_type(x, jnp.float32))
         y = jnp.matmul(x.astype(k.dtype), k)
-    if "bias" in layer_params:
-        y = y + layer_params["bias"].astype(y.dtype)
+    bias_name = prefix[:-6] + "bias"  # 'kernel' -> 'bias', 'o_kernel' -> 'o_bias'
+    if bias_name in layer_params:
+        y = y + layer_params[bias_name].astype(y.dtype)
     return y
 
 
+def quantize_for_serving(model, params, mode: str = "weight_only",
+                         min_size: int = 4096):
+    """Shared implementation behind ``GraphModel.quantize_for_serving`` and
+    ``RegistryModel.quantize_for_serving``: validate, set the model's
+    ``quant_mode``, return the quantized tree."""
+    if mode not in MODES:
+        raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
+    model.quant_mode = mode
+    return quantize_params(params, min_size=min_size)
+
+
 def _is_matmul_kernel(path_leaf: str, arr) -> bool:
-    return path_leaf == "kernel" and getattr(arr, "ndim", 0) == 2
+    # 'kernel' (graphdef dense / classifier head) or the transformer
+    # family's named projections ('qkv_kernel', 'o_kernel', 'fc1_kernel', ...)
+    return ((path_leaf == "kernel" or path_leaf.endswith("_kernel"))
+            and getattr(arr, "ndim", 0) == 2)
 
 
 def _is_conv_kernel(path_leaf: str, arr) -> bool:
-    return path_leaf == "kernel" and getattr(arr, "ndim", 0) == 4
+    return ((path_leaf == "kernel" or path_leaf.endswith("_kernel"))
+            and getattr(arr, "ndim", 0) == 4)
 
 
 def quantize_params(params: Dict[str, Dict[str, Any]],
@@ -123,8 +141,8 @@ def quantize_params(params: Dict[str, Dict[str, Any]],
             if ((_is_matmul_kernel(name, arr) or _is_conv_kernel(name, arr))
                     and size >= min_size):
                 q8, scale = quantize_tensor(arr, axis=-1)  # per out-channel
-                out["kernel_q8"] = q8
-                out["kernel_scale"] = scale
+                out[f"{name}_q8"] = q8
+                out[f"{name}_scale"] = scale
             else:
                 out[name] = arr
         return out

@@ -1,0 +1,38 @@
+"""Structural checks on examples/: every example must be directly runnable
+(``python examples/foo.py`` from any cwd), which requires the repo-root
+sys.path bootstrap — without it the import fails outside an installed
+package — and a wedged-relay guard before first device use so examples
+don't hang on a dead accelerator tunnel."""
+
+import os
+import py_compile
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _example_files():
+    return sorted(f for f in os.listdir(EXAMPLES) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("fname", _example_files())
+def test_example_compiles(fname):
+    py_compile.compile(os.path.join(EXAMPLES, fname), doraise=True)
+
+
+@pytest.mark.parametrize("fname", _example_files())
+def test_example_has_path_bootstrap(fname):
+    src = open(os.path.join(EXAMPLES, fname)).read()
+    assert "sys.path.insert" in src, (
+        f"{fname} lacks the repo-root sys.path bootstrap; "
+        f"`python examples/{fname}` would fail with ModuleNotFoundError")
+
+
+@pytest.mark.parametrize("fname", _example_files())
+def test_example_guards_against_wedged_relay(fname):
+    src = open(os.path.join(EXAMPLES, fname)).read()
+    assert "ensure_live_backend" in src, (
+        f"{fname} never calls ensure_live_backend(); it would hang forever "
+        f"on a wedged TPU relay instead of falling back to CPU")

@@ -144,10 +144,65 @@ def test_predict_func_rejects_bad_mode_and_non_graphdef_models():
         _cached_quantized_params(gm, "[]", "dyanmic")  # typo
 
     reg = model_from_json(build_registry_spec(
-        "transformer_classifier", vocab_size=50, num_classes=2, hidden=16,
-        num_layers=1, num_heads=2, mlp_dim=32, max_len=8))
-    with pytest.raises(ValueError, match="graphdef"):
+        "rnn_classifier", vocab_size=50, num_classes=2, hidden=16,
+        num_layers=1, max_len=8))
+    with pytest.raises(ValueError, match="without quantization"):
         _cached_quantized_params(reg, "[]", "weight_only")
+    with pytest.raises(ValueError, match="int8 serving"):
+        reg.quantize_for_serving({}, mode="weight_only")
+
+
+@pytest.mark.parametrize("mode", ["weight_only", "dynamic"])
+def test_transformer_quantized_serving_tracks_f32(mode):
+    """The flagship family serves int8: every block projection (qkv/o/fc1/
+    fc2) consumes the quantized tree; class decisions track full precision."""
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+
+    m = model_from_json(build_registry_spec(
+        "transformer_classifier", vocab_size=64, num_classes=4, hidden=32,
+        num_layers=2, num_heads=4, mlp_dim=64, max_len=16, dropout=0.0))
+    params = m.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(6)
+    ids = jnp.asarray(rs.randint(0, 64, (32, 16)), jnp.int32)
+
+    fp = np.asarray(m.apply(params, {"input_ids": ids}, ["logits"])["logits"])
+    qparams = m.quantize_for_serving(params, mode=mode, min_size=1024)
+    try:
+        # every block got its projections quantized
+        assert "qkv_kernel_q8" in qparams["block_0"]
+        assert "fc1_kernel_q8" in qparams["block_1"]
+        qp = np.asarray(m.apply(qparams, {"input_ids": ids}, ["logits"])["logits"])
+    finally:
+        m.quant_mode = None
+    tol = 0.06 * (fp.max() - fp.min() + 1e-6)
+    assert np.abs(qp - fp).max() < tol
+    agree = (qp.argmax(axis=1) == fp.argmax(axis=1)).mean()
+    assert agree >= 0.95
+
+
+def test_moe_transformer_quantized_serving():
+    """MoE blocks quantize their attention projections; the expert banks
+    (3-D) and router stay full precision."""
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+
+    m = model_from_json(build_registry_spec(
+        "transformer_moe_lm", vocab_size=64, num_experts=4, moe_every=1,
+        hidden=32, num_layers=2, num_heads=4, mlp_dim=64, max_len=16,
+        dropout=0.0))
+    params = m.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+    fp = np.asarray(m.apply(params, {"input_ids": ids}, ["logits"])["logits"])
+    qparams = m.quantize_for_serving(params, min_size=1024)
+    try:
+        assert "qkv_kernel_q8" in qparams["block_0"]
+        assert "experts_fc1" in qparams["block_0"]  # expert bank untouched
+        assert qparams["block_0"]["router"].dtype == jnp.float32
+        qp = np.asarray(m.apply(qparams, {"input_ids": ids}, ["logits"])["logits"])
+    finally:
+        m.quant_mode = None
+    tol = 0.06 * (fp.max() - fp.min() + 1e-6)
+    assert np.abs(qp - fp).max() < tol
 
 
 def test_quantized_dense_respects_compute_dtype():
@@ -162,6 +217,32 @@ def test_quantized_dense_respects_compute_dtype():
     x = jnp.asarray(rs.randn(4, 32), jnp.bfloat16)
     y = quantized_dense(x, layer, "weight_only", compute_dtype=jnp.bfloat16)
     assert y.dtype == jnp.bfloat16
+
+
+def test_quant_cache_sees_npz_rewrites(tmp_path):
+    """npz side-file weights key the quantized-tree cache on (path, mtime,
+    size): refitting and overwriting the same path must not serve the old
+    quantized weights."""
+    import time
+
+    from sparkflow_tpu.ml_util import _cached_quantized_params
+    from sparkflow_tpu.model_loader import save_weights_npz
+    from sparkflow_tpu.graphdef import params_to_list
+
+    model = GraphModel.from_json(build_graph(_mlp))
+    p1 = model.init(jax.random.PRNGKey(0))
+    p2 = model.init(jax.random.PRNGKey(1))
+    path = str(tmp_path / "w.npz")
+
+    save_weights_npz(path, params_to_list(model, p1))
+    q1 = _cached_quantized_params(model, "npz:" + path, "weight_only")
+    time.sleep(0.01)  # ensure mtime_ns differs across rewrites
+    save_weights_npz(path, params_to_list(model, p2))
+    q2 = _cached_quantized_params(model, "npz:" + path, "weight_only")
+    d = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                  b.astype(jnp.float32))))
+            for a, b in zip(jax.tree.leaves(q1), jax.tree.leaves(q2)))
+    assert d > 0.0, "cache served stale quantized weights after npz rewrite"
 
 
 def test_estimator_inference_quantize_end_to_end():
