@@ -174,7 +174,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   batch_size: int, num_batches: int, mode: str,
                   shuffle: bool, mesh: Optional[Mesh] = None,
                   n_real: Optional[int] = None, _raw: bool = False,
-                  infer_params: bool = False) -> Callable:
+                  infer_params: bool = False,
+                  _unroll_budget: Optional[int] = None) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -242,13 +243,33 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
             params, opt_state, loss = step(params, opt_state, x, y, m, r)
             return (params, opt_state), loss
 
-        (params, opt_state), losses = jax.lax.scan(body, (params, opt_state),
-                                                   (xb, yb, mb, step_rngs))
+        # the budget is the caller's TOTAL step count: unrolling this scan
+        # inside a still-looped outer (multi-epoch) scan would balloon the
+        # program with zero benefit — every op stays in the while loop
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (xb, yb, mb, step_rngs),
+            unroll=_cpu_unroll(_unroll_budget if _unroll_budget is not None
+                               else num_batches))
         return params, opt_state, losses
 
     if _raw:
         return epoch
     return _jit_epoch_like(epoch, mesh, infer_params)
+
+
+# XLA:CPU runs large ops (convolutions especially) inside while loops ~30x
+# slower than the same ops at top level — measured 0.98s/step standalone vs
+# 27s/step inside lax.scan for the batch-1024 MNIST CNN. TPU has no such
+# cliff, and the fused scan program is the TPU fast path, so the workaround
+# is CPU-only: fully unroll epoch scans when the trip count is small enough
+# that compile time stays bounded. Numerics are identical either way.
+_CPU_UNROLL_MAX = 32
+
+
+def _cpu_unroll(length: int):
+    if length <= _CPU_UNROLL_MAX and jax.default_backend() == "cpu":
+        return True
+    return 1
 
 
 def make_multi_epoch_fn(loss_fn: Callable,
@@ -274,7 +295,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
     the per-epoch loop does, so losses match the loop path bit-for-bit.
     """
     body = make_epoch_fn(loss_fn, optimizer, batch_size, num_batches, mode,
-                         shuffle, n_real=n_real, _raw=True)
+                         shuffle, n_real=n_real, _raw=True,
+                         _unroll_budget=n_epochs * num_batches)
 
     def run(params, opt_state, data, labels, mask, erngs):
         def step(carry, erng):
@@ -282,8 +304,12 @@ def make_multi_epoch_fn(loss_fn: Callable,
             p, s, losses = body(p, s, data, labels, mask, erng)
             return (p, s), losses
 
+        # both scan levels must unroll together on CPU: an unrolled epoch
+        # body inside a while-looped epoch scan still puts every op in the
+        # loop (see _cpu_unroll) — so the budget is TOTAL steps
         (params, opt_state), losses = jax.lax.scan(
-            step, (params, opt_state), erngs)
+            step, (params, opt_state), erngs,
+            unroll=_cpu_unroll(n_epochs * num_batches))
         return params, opt_state, losses
 
     return _jit_epoch_like(run, mesh, infer_params)
