@@ -53,7 +53,12 @@ logger = logging.getLogger("sparkflow_tpu")
 
 def _ckpt_state(params, opt_state, step, rng):
     """The checkpoint payload schema — single source of truth for every
-    save/restore site in fit and fit_stream."""
+    save/restore site in fit and fit_stream. Typed PRNG keys (rng_impl set)
+    checkpoint as their raw key data; _restore_rng re-wraps them."""
+    import jax.dtypes
+    if hasattr(rng, "dtype") and jax.dtypes.issubdtype(rng.dtype,
+                                                       jax.dtypes.prng_key):
+        rng = jax.random.key_data(rng)
     return {"params": params, "opt_state": opt_state,
             "epoch": np.int64(step), "rng": np.asarray(rng)}
 
@@ -103,7 +108,8 @@ class Trainer:
                  straggler_factor: Optional[float] = None,
                  straggler_callback: Optional[Callable] = None,
                  metrics=None,
-                 param_sharding: Union[str, None, dict] = "auto"):
+                 param_sharding: Union[str, None, dict] = "auto",
+                 rng_impl: Optional[str] = None):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -138,6 +144,12 @@ class Trainer:
         self.dropout_name = dropout_name
         self.mesh = mesh
         self.seed = seed
+        # rng_impl='rbg' swaps the dropout/shuffle key stream to the TPU's
+        # hardware PRNG (typed keys carry their impl through split/fold_in/
+        # bernoulli): threefry mask generation is pure VPU overhead on the
+        # training step — dropout-heavy transformers reclaim it. None keeps
+        # JAX's default threefry stream (bit-reproducible with prior rounds).
+        self.rng_impl = rng_impl
         self.params = None
         self._epoch_cache = {}  # (batch, num_batches, mode, shuffle) -> compiled epoch
         # step-level checkpoint/resume — a capability upgrade over the
@@ -239,6 +251,33 @@ class Trainer:
 
     # -- fit ----------------------------------------------------------------
 
+    def _make_rng(self):
+        """Root key for this fit: default threefry, or a typed key on the
+        configured ``rng_impl`` (e.g. 'rbg' — see __init__)."""
+        if self.rng_impl:
+            return jax.random.key(self.seed, impl=self.rng_impl)
+        return jax.random.PRNGKey(self.seed)
+
+    def _restore_rng(self, raw):
+        """Inverse of _ckpt_state's key handling: re-wrap raw key data under
+        the configured impl. The key-data width identifies the impl that
+        saved the checkpoint (threefry: 2 uint32 words, rbg: 4), so a
+        mismatched ``rng_impl`` fails with an actionable error instead of a
+        raw shape error deep inside jax.random."""
+        raw = jnp.asarray(raw)
+        expect = 4 if self.rng_impl in ("rbg", "unsafe_rbg") else 2
+        got = raw.shape[-1] if raw.ndim else None
+        if got != expect:
+            raise ValueError(
+                f"checkpoint rng has {got} key-data words but rng_impl="
+                f"{self.rng_impl!r} expects {expect}: this checkpoint_dir was "
+                f"written under a different rng_impl — resume with the "
+                f"matching rng_impl, or point checkpoint_dir at a fresh "
+                f"directory to restart the rng stream")
+        if self.rng_impl:
+            return jax.random.wrap_key_data(raw, impl=self.rng_impl)
+        return raw
+
     def fit(self, features, labels: Optional[np.ndarray] = None,
             init_params=None) -> TrainResult:
         # multi-input features travel as a TUPLE of arrays; a plain list is
@@ -283,7 +322,7 @@ class Trainer:
         else:
             y_pad = np.zeros((total, 1), np.float32)  # dummy; loss ignores it
 
-        rng = jax.random.PRNGKey(self.seed)
+        rng = self._make_rng()
         init_rng, rng = jax.random.split(rng)
         if init_params is not None:
             # copy: the epoch program donates its params buffers, which would
@@ -318,7 +357,7 @@ class Trainer:
                     # the first compiled step after resume)
                     params = self._place_params(params, pspecs)
                 start_epoch = int(state["epoch"])
-                rng = jnp.asarray(state["rng"])
+                rng = self._restore_rng(state["rng"])
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
@@ -457,7 +496,7 @@ class Trainer:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
                 start_epoch = int(state["epoch"])
-                rng = jnp.asarray(state["rng"])
+                rng = self._restore_rng(state["rng"])
                 # epochs past the restore point will re-run: drop their losses
                 loss_by_it = {k: v for k, v in loss_by_it.items()
                               if k <= start_epoch}
@@ -510,7 +549,7 @@ class Trainer:
                              "(streams are single-pass)")
 
         supervised = self.label_name is not None
-        rng = jax.random.PRNGKey(self.seed)
+        rng = self._make_rng()
         init_rng, rng = jax.random.split(rng)
 
         bs = self.mini_batch_size if self.mini_batch_size and self.mini_batch_size > 0 else 128
@@ -549,7 +588,7 @@ class Trainer:
                 if pspecs is not None:
                     params = self._place_params(params, pspecs)
                 start_step = int(state["epoch"])
-                rng = jnp.asarray(state["rng"])
+                rng = self._restore_rng(state["rng"])
                 logger.info("fit_stream resumed weights from step %d",
                             start_step)
 
