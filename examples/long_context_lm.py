@@ -42,7 +42,10 @@ def main():
         num_layers=2 if smoke else 8,
         num_heads=4 if smoke else 8,
         mlp_dim=128 if smoke else 2048,
-        max_len=seq, dropout=0.0, remat=not smoke)
+        # 'dots' saves matmul outputs and recomputes only the cheap
+        # elementwise ops — far less backward recompute than full remat,
+        # still bounded activation memory at long sequence lengths
+        max_len=seq, dropout=0.0, remat="dots" if not smoke else False)
 
     lm = model_from_json(spec)
     mesh = make_mesh({"dp": dp, "sp": sp})

@@ -60,9 +60,22 @@ class _TransformerBase(RegistryModel):
         self.mlp_dim = mlp_dim
         self.max_len = max_len
         self.dropout = dropout
+        # remat: False | True/'full' (recompute everything in the block) |
+        # 'dots' (save matmul outputs, recompute the cheap elementwise rest
+        # — the MFU-friendly middle ground: backward skips the flops-heavy
+        # recompute that full remat pays, while activation memory stays far
+        # below no-remat; the standard policy for long-context training)
+        if remat not in (False, True, "full", "dots"):
+            raise ValueError(
+                f"remat must be False, True/'full', or 'dots'; got {remat!r}")
         self.remat = remat
         self.sp_axis = sp_axis  # set to the mesh axis name for ring attention
         super().__init__(compute_dtype)
+
+    def _remat_policy(self):
+        if self.remat == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return None  # full recompute
 
     # -- specs ---------------------------------------------------------------
 
@@ -187,7 +200,8 @@ class _TransformerBase(RegistryModel):
             rng = jax.random.PRNGKey(0)
         block = self._block_aux
         if self.remat:
-            block = jax.checkpoint(self._block_aux, static_argnums=(3, 4))
+            block = jax.checkpoint(self._block_aux, static_argnums=(3, 4),
+                                   policy=self._remat_policy())
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.num_layers):
             x, rng, aux = block(params[f"block_{i}"], x, mask, causal, train, rng)

@@ -399,3 +399,37 @@ def test_tp_training_update_exact_vs_single_device():
     for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(sgd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-5)
+
+
+def test_remat_modes_identical_numerics():
+    """remat=False / True (full) / 'dots' (save matmul outputs) must give
+    identical losses and gradients — remat trades memory for recompute,
+    never numerics. Bad mode fails loudly."""
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 50, (4, 16)), jnp.int32)
+
+    results = {}
+    for mode in (False, True, "dots"):
+        m = model_from_json(build_registry_spec(
+            "transformer_lm", vocab_size=50, hidden=32, num_layers=2,
+            num_heads=4, mlp_dim=64, max_len=16, dropout=0.0, remat=mode))
+        params = m.init(jax.random.PRNGKey(0))
+
+        def loss(p):
+            return m.loss_vector(p, {"input_ids": ids}, train=False).mean()
+
+        l, g = jax.value_and_grad(loss)(params)
+        results[mode] = (float(l), g)
+
+    l0, g0 = results[False]
+    for mode in (True, "dots"):
+        l, g = results[mode]
+        assert abs(l - l0) < 1e-6, (mode, l, l0)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="remat"):
+        model_from_json(build_registry_spec(
+            "transformer_lm", vocab_size=50, hidden=32, num_layers=1,
+            num_heads=4, mlp_dim=64, max_len=16, remat="everything"))
