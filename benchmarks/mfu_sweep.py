@@ -18,15 +18,21 @@ Usage: python benchmarks/mfu_sweep.py [--quick]
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 QUICK = "--quick" in sys.argv
 
 
 def main():
+    from sparkflow_tpu.utils.hw import ensure_live_backend
+    ensure_live_backend()
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -93,6 +99,8 @@ def main():
             ids, y = batch(0)
             params, state, loss = step(params, state, ids, y, key(0))
             jax.block_until_ready(params)
+            from sparkflow_tpu.ops.attention import last_attention_path
+            attn_path = last_attention_path()  # what actually traced
             t0 = time.perf_counter()
             for i in range(n_steps):
                 ids, y = batch(i + 1)
@@ -103,8 +111,11 @@ def main():
             B, cfg["max_len"], cfg["hidden"], cfg["num_layers"],
             cfg["mlp_dim"], num_classes=2)
         rec = {"batch": B, "dropout": dropout, "rng": rng_impl,
-               "attn": ("xla" if force_xla_attn else
-                        f"pallas{block_q or ''}x{block_k or ''}"),
+               # the path flash_attention ACTUALLY traced, not the requested
+               # one: a tile-rule fallback must not misattribute the delta
+               "attn": attn_path,
+               "requested": ("xla" if force_xla_attn else
+                             f"pallas{block_q or ''}x{block_k or ''}"),
                "ms_per_step": round(dt * 1e3, 1),
                "examples_per_sec": round(B / dt, 1),
                "tflops_per_sec": round(fl / dt / 1e12, 2)}
@@ -115,6 +126,14 @@ def main():
         return dt
 
     B0 = 8 if QUICK else 32
+    if "--trace" in sys.argv:
+        # one profiled measurement for hotspot attribution (open the
+        # resulting trace in Perfetto / tensorboard)
+        from sparkflow_tpu.utils.tracing import trace
+        with trace("/tmp/mfu_trace"):
+            measure(B0, dropout=0.1)
+        print(json.dumps({"trace_written": "/tmp/mfu_trace"}), flush=True)
+        return
     # batch ladder (the first lever)
     for B in ((4, 8) if QUICK else (16, 32, 64, 128)):
         try:

@@ -284,3 +284,29 @@ def test_estimator_inference_quantize_end_to_end():
     fitted.setParams(inferenceQuantize="int4")
     with pytest.raises(ValueError, match="inferenceQuantize"):
         fitted.transform(df)
+
+
+def test_quantized_predict_on_dp_mesh():
+    """Mesh-sharded inference serves quantized trees: the replicated-params
+    jit shardings broadcast over the q8 tree unchanged."""
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    model = GraphModel.from_json(build_graph(_mlp))
+    params = model.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(8)
+    x = rs.rand(100, 32).astype(np.float32)
+    mesh = make_mesh({"dp": 8})
+
+    fp = np.asarray(predict_in_chunks(
+        make_predict_fn(model, "x:0", "out:0", mesh=mesh), params, x))
+    q = model.quantize_for_serving(params, mode="dynamic", min_size=256)
+    try:
+        qp = np.asarray(predict_in_chunks(
+            make_predict_fn(model, "x:0", "out:0", mesh=mesh), q, x))
+    finally:
+        model.quant_mode = None
+    assert qp.shape == fp.shape
+    assert np.abs(qp - fp).max() < 0.05 * (fp.max() - fp.min() + 1e-6)
