@@ -128,8 +128,9 @@ def _cached_quantized_params(model, graph_weights: str, quantize: str):
     if not supports:
         raise ValueError(
             f"int8 serving (inferenceQuantize) supports graphdef models (the "
-            f"nn DSL / build_graph) and the transformer family; got "
-            f"{type(model).__name__} — serve this model without quantization")
+            f"nn DSL / build_graph), TF1 metagraphs, and the transformer "
+            f"family; got {type(model).__name__} — serve this model without "
+            f"quantization")
     # the tree is mode-agnostic (quant.py), so the key is the weights alone;
     # npz side-files key on (path, mtime, size) — the string digest would
     # serve stale weights after a refit overwrites the same path

@@ -210,6 +210,10 @@ class _Names:
 class TF1GraphModel:
     """Executable wrapper for a TF1 MetaGraphDef JSON (see module docstring)."""
 
+    # quantized serving trees dequantize at the variable read (weight-only
+    # regardless of the requested mode — see _param_value)
+    SUPPORTS_INT8_SERVING = True
+
     def __init__(self, graph_json: str, compute_dtype=None):
         d = json.loads(graph_json) if isinstance(graph_json, str) else graph_json
         gd = d.get("graphDef") or d.get("graph_def") or {}
@@ -296,7 +300,17 @@ class TF1GraphModel:
 
     def _param_value(self, params, vname: str):
         scope, leaf = self._param_key(vname)
-        return params[scope][leaf]
+        layer = params[scope]
+        if leaf not in layer and f"{leaf}_q8" in layer:
+            # int8-quantized serving tree (utils/quant.py): TF1 graphs
+            # dequantize at the variable read — weight-only semantics, so
+            # every downstream op is untouched (the interpreter can't know
+            # which consumer is a matmul, so the dynamic int8 path doesn't
+            # apply here)
+            from .utils.quant import dequantize_tensor
+            return dequantize_tensor(layer[f"{leaf}_q8"],
+                                     layer[f"{leaf}_scale"])
+        return layer[leaf]
 
     def init(self, rng):
         params: Dict[str, Dict[str, Any]] = {}
@@ -311,6 +325,15 @@ class TF1GraphModel:
             scope, leaf = self._param_key(vname)
             params.setdefault(scope, {})[leaf] = val
         return params
+
+    def quantize_for_serving(self, params, mode: str = "weight_only",
+                             min_size: int = 4096):
+        """int8-quantize a trained params tree for inference
+        (``utils/quant.py``). TF1 graphs always serve weight-only — the
+        interpreter dequantizes at the variable read, so a 'dynamic'
+        request is accepted but behaves as weight-only."""
+        from .utils.quant import quantize_for_serving
+        return quantize_for_serving(self, params, mode, min_size)
 
     def apply(self, params, feeds: Dict[str, Any], outputs: Sequence[str],
               train: bool = False, rng=None) -> Dict[str, Any]:

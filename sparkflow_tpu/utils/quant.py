@@ -96,13 +96,31 @@ def quantized_dense(x, layer_params, mode: str = "weight_only",
 
 def quantize_for_serving(model, params, mode: str = "weight_only",
                          min_size: int = 4096):
-    """Shared implementation behind ``GraphModel.quantize_for_serving`` and
-    ``RegistryModel.quantize_for_serving``: validate, set the model's
-    ``quant_mode``, return the quantized tree."""
+    """Shared implementation behind the model families'
+    ``quantize_for_serving``: validate, set the model's ``quant_mode``,
+    return the quantized tree. Warns when NO leaf quantized — naming
+    conventions the matcher doesn't know (e.g. TF1 graphs with variables
+    named 'W1'/'weights', or everything under ``min_size``) would otherwise
+    silently serve full precision while the caller believes it's int8."""
     if mode not in MODES:
         raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
     model.quant_mode = mode
-    return quantize_params(params, min_size=min_size)
+    q = quantize_params(params, min_size=min_size)
+
+    def _count_q8(d):
+        return sum(_count_q8(v) if isinstance(v, dict)
+                   else int(isinstance(k, str) and k.endswith("_q8"))
+                   for k, v in d.items())
+
+    if _count_q8(q) == 0:
+        import logging
+        logging.getLogger(__name__).warning(
+            "quantize_for_serving(%s): no kernel leaf quantized — every "
+            "matmul/conv kernel is either below min_size=%d elements or not "
+            "named 'kernel'/'*_kernel' (e.g. raw TF1 variables named "
+            "'W'/'weights'); serving will run FULL PRECISION",
+            type(model).__name__, min_size)
+    return q
 
 
 def _is_matmul_kernel(path_leaf: str, arr) -> bool:

@@ -310,3 +310,16 @@ def test_quantized_predict_on_dp_mesh():
         model.quant_mode = None
     assert qp.shape == fp.shape
     assert np.abs(qp - fp).max() < 0.05 * (fp.max() - fp.min() + 1e-6)
+
+
+def test_quantize_for_serving_warns_when_nothing_quantizes(caplog):
+    """Unmatched naming / everything under min_size must not silently serve
+    f32 while the caller believes it's int8."""
+    import logging
+
+    model = GraphModel.from_json(build_graph(_mlp))
+    params = model.init(jax.random.PRNGKey(0))
+    with caplog.at_level(logging.WARNING, logger="sparkflow_tpu.utils.quant"):
+        model.quantize_for_serving(params, min_size=10**9)
+    model.quant_mode = None
+    assert any("FULL PRECISION" in r.message for r in caplog.records)

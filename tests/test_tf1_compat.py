@@ -707,3 +707,22 @@ def test_differential_fuzz_extended_ops():
         lv = np.asarray(m.loss_vector(params, {"x": X, "y": Y}, train=False))
         np.testing.assert_allclose(lv.mean(), float(tf_loss), rtol=1e-4,
                                    err_msg=f"extended trial {trial} loss")
+
+
+def test_tf1_quantized_serving_tracks_f32(softmax_metagraph):
+    """int8 serving covers the TF1 wire format too: the interpreter
+    dequantizes at the variable read (weight-only semantics), so quantized
+    trees serve through the same apply path with no per-op support."""
+    m = model_from_json(softmax_metagraph)
+    params = m.init(__import__("jax").random.PRNGKey(0))
+    X = np.random.RandomState(1).rand(32, 4).astype(np.float32)
+
+    fp = np.asarray(m.apply(params, {"x": X}, ["probs:0"])["probs:0"])
+    qparams = m.quantize_for_serving(params, min_size=8)
+    try:
+        assert "kernel_q8" in qparams["h1"]  # 4x16=64 >= 8 quantized
+        qp = np.asarray(m.apply(qparams, {"x": X}, ["probs:0"])["probs:0"])
+    finally:
+        m.quant_mode = None
+    assert np.abs(qp - fp).max() < 0.05
+    assert (qp.argmax(axis=1) == fp.argmax(axis=1)).mean() >= 0.95
