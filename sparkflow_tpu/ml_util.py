@@ -114,7 +114,8 @@ _QUANT_CACHE: "OrderedDict[str, Any]" = OrderedDict()
 _QUANT_CACHE_MAX = 8
 
 
-def _cached_quantized_params(model, graph_weights: str, quantize: str):
+def _cached_quantized_params(model, graph_weights: str, quantize: str,
+                             graph_digest: str = ""):
     from .graphdef import GraphModel
     from .utils.quant import MODES, quantize_params
 
@@ -131,15 +132,19 @@ def _cached_quantized_params(model, graph_weights: str, quantize: str):
             f"nn DSL / build_graph), TF1 metagraphs, and the transformer "
             f"family; got {type(model).__name__} — serve this model without "
             f"quantization")
-    # the tree is mode-agnostic (quant.py), so the key is the weights alone;
-    # npz side-files key on (path, mtime, size) — the string digest would
-    # serve stale weights after a refit overwrites the same path
+    # the tree is mode-agnostic (quant.py) but its scope/leaf naming is the
+    # MODEL's, so the key pairs the graph digest with the weights identity —
+    # the same flat weights served through two model types (graphdef vs TF1
+    # export of the same network) must not collide. npz side-files key on
+    # (path, mtime, size): the string digest would serve stale weights after
+    # a refit overwrites the same path
     if graph_weights.startswith("npz:"):
         import os as _os
         st = _os.stat(graph_weights[4:])
-        key = f"{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
+        key = f"{graph_digest}:{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
     else:
-        key = hashlib.sha256(graph_weights.encode()).hexdigest()
+        key = (graph_digest + ":"
+               + hashlib.sha256(graph_weights.encode()).hexdigest())
     if key not in _QUANT_CACHE:
         params = list_to_params(model, resolve_weights(graph_weights))
         _QUANT_CACHE[key] = quantize_params(params)
@@ -172,7 +177,9 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
     model, fn = _cached_predict_fn(graph_json, activation, names,
                                    tf_dropout, dropout_v, quantize)
     if quantize:
-        params = _cached_quantized_params(model, graph_weights, quantize)
+        params = _cached_quantized_params(
+            model, graph_weights, quantize,
+            graph_digest=hashlib.sha256(graph_json.encode()).hexdigest())
     else:
         params = list_to_params(model, resolve_weights(graph_weights))
     cols = [inp] + list(extra_cols) if extra_cols else [inp]

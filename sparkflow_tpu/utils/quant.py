@@ -105,22 +105,7 @@ def quantize_for_serving(model, params, mode: str = "weight_only",
     if mode not in MODES:
         raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
     model.quant_mode = mode
-    q = quantize_params(params, min_size=min_size)
-
-    def _count_q8(d):
-        return sum(_count_q8(v) if isinstance(v, dict)
-                   else int(isinstance(k, str) and k.endswith("_q8"))
-                   for k, v in d.items())
-
-    if _count_q8(q) == 0:
-        import logging
-        logging.getLogger(__name__).warning(
-            "quantize_for_serving(%s): no kernel leaf quantized — every "
-            "matmul/conv kernel is either below min_size=%d elements or not "
-            "named 'kernel'/'*_kernel' (e.g. raw TF1 variables named "
-            "'W'/'weights'); serving will run FULL PRECISION",
-            type(model).__name__, min_size)
-    return q
+    return quantize_params(params, min_size=min_size)
 
 
 def _is_matmul_kernel(path_leaf: str, arr) -> bool:
@@ -145,6 +130,13 @@ def quantize_params(params: Dict[str, Dict[str, Any]],
     The quantized tree is mode-agnostic; the serving model's ``quant_mode``
     ('weight_only' | 'dynamic') picks the matmul path. Conv kernels always
     serve weight-only.
+
+    Warns when NO leaf quantized — naming conventions the matcher doesn't
+    know (e.g. TF1 graphs with variables named 'W'/'weights', or everything
+    under ``min_size``) would otherwise silently serve full precision while
+    the caller believes it's int8. The warning lives HERE so every entry
+    point (quantize_for_serving, the estimator's serving-side
+    _cached_quantized_params) gets it.
     """
 
     def qlayer(layer):
@@ -165,7 +157,21 @@ def quantize_params(params: Dict[str, Dict[str, Any]],
                 out[name] = arr
         return out
 
-    return {k: qlayer(v) for k, v in params.items()}
+    q = {k: qlayer(v) for k, v in params.items()}
+
+    def _count_q8(d):
+        return sum(_count_q8(v) if isinstance(v, dict)
+                   else int(isinstance(k, str) and k.endswith("_q8"))
+                   for k, v in d.items())
+
+    if _count_q8(q) == 0:
+        import logging
+        logging.getLogger(__name__).warning(
+            "quantize_params: no kernel leaf quantized — every matmul/conv "
+            "kernel is either below min_size=%d elements or not named "
+            "'kernel'/'*_kernel' (e.g. raw TF1 variables named "
+            "'W'/'weights'); serving will run FULL PRECISION", min_size)
+    return q
 
 
 def np_size(arr) -> int:

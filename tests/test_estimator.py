@@ -542,3 +542,34 @@ def test_fit_mode_stream_with_fsdp_mesh(spark, gaussian_df):
     model = base_estimator(mg, iters=20, fitMode="stream", miniBatchSize=64,
                            meshShape="dp=2,fsdp=4").fit(gaussian_df)
     assert calculate_errors(model.transform(gaussian_df)) < 400
+
+
+def test_mesh_shape_ep_moe(spark):
+    """ep via meshShape on a registry MoE LM (expert banks carry P('ep',...)
+    rules): estimator-level expert parallelism, weights matching the
+    default replicated fit — sharding is placement, not math."""
+    from sparkflow_tpu.models import build_registry_spec
+
+    spec = build_registry_spec("transformer_moe_lm", vocab_size=30,
+                               num_experts=8, moe_every=1, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=8, dropout=0.0, capacity_factor=8.0)
+    rs = np.random.RandomState(9)
+    rows = [(Vectors.dense(rs.randint(0, 30, 8).astype(float)),)
+            for _ in range(64)]
+    df = spark.createDataFrame(rows, ["features"])
+
+    def est(**kw):
+        # unsupervised: causal-LM loss over the token column itself
+        return SparkAsyncDL(inputCol="features", tensorflowGraph=spec,
+                            tfInput="input_ids", tfLabel=None, labelCol=None,
+                            tfOutput="logits", tfOptimizer="adam",
+                            tfLearningRate=.01, iters=4, miniBatchSize=16,
+                            predictionCol="predicted", **kw)
+
+    m_ep = est(meshShape="ep=8").fit(df)
+    m_dp = est().fit(df)
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    for a, b in zip(convert_json_to_weights(m_ep.getOrDefault(m_ep.modelWeights)),
+                    convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
+        np.testing.assert_allclose(a, b, atol=5e-4)
