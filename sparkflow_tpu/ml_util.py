@@ -114,8 +114,7 @@ _QUANT_CACHE: "OrderedDict[str, Any]" = OrderedDict()
 _QUANT_CACHE_MAX = 8
 
 
-def _cached_quantized_params(model, graph_weights: str, quantize: str,
-                             graph_digest: str = ""):
+def _cached_quantized_params(model, graph_weights: str, quantize: str):
     from .graphdef import GraphModel
     from .utils.quant import MODES, quantize_params
 
@@ -133,17 +132,21 @@ def _cached_quantized_params(model, graph_weights: str, quantize: str,
             f"family; got {type(model).__name__} — serve this model without "
             f"quantization")
     # the tree is mode-agnostic (quant.py) but its scope/leaf naming is the
-    # MODEL's, so the key pairs the graph digest with the weights identity —
-    # the same flat weights served through two model types (graphdef vs TF1
-    # export of the same network) must not collide. npz side-files key on
-    # (path, mtime, size): the string digest would serve stale weights after
-    # a refit overwrites the same path
+    # MODEL's, so the key pairs the model's param-tree naming with the
+    # weights identity — the same flat weights served through two model
+    # types (graphdef vs TF1 export of the same network) must not collide.
+    # Derived IN here (not caller-supplied) so every entry point is covered.
+    # npz side-files key on (path, mtime, size): the string digest would
+    # serve stale weights after a refit overwrites the same path
+    naming = hashlib.sha256(repr(
+        [(scope, sorted(leaves)) for scope, leaves in
+         model.param_specs().items()]).encode()).hexdigest()[:16]
     if graph_weights.startswith("npz:"):
         import os as _os
         st = _os.stat(graph_weights[4:])
-        key = f"{graph_digest}:{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
+        key = f"{naming}:{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
     else:
-        key = (graph_digest + ":"
+        key = (naming + ":"
                + hashlib.sha256(graph_weights.encode()).hexdigest())
     if key not in _QUANT_CACHE:
         params = list_to_params(model, resolve_weights(graph_weights))
@@ -177,9 +180,7 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
     model, fn = _cached_predict_fn(graph_json, activation, names,
                                    tf_dropout, dropout_v, quantize)
     if quantize:
-        params = _cached_quantized_params(
-            model, graph_weights, quantize,
-            graph_digest=hashlib.sha256(graph_json.encode()).hexdigest())
+        params = _cached_quantized_params(model, graph_weights, quantize)
     else:
         params = list_to_params(model, resolve_weights(graph_weights))
     cols = [inp] + list(extra_cols) if extra_cols else [inp]

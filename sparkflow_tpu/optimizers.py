@@ -310,9 +310,14 @@ def _build_base_optimizer(optimizer_name: str, lr, opts
                           ) -> optax.GradientTransformation:
 
     if optimizer_name == "adam":
+        # mu_dtype='bfloat16' halves the first-moment HBM (the second moment
+        # and params stay f32) — the standard large-model memory lever; the
+        # update math still runs f32 (optax upcasts mu before use)
+        mu_dtype = _pop(opts, "mu_dtype", default=None)
         return optax.adam(lr, b1=_pop(opts, "beta1", "b1", default=0.9),
                           b2=_pop(opts, "beta2", "b2", default=0.999),
-                          eps=_pop(opts, "epsilon", "eps", default=1e-8))
+                          eps=_pop(opts, "epsilon", "eps", default=1e-8),
+                          mu_dtype=mu_dtype)
     if optimizer_name == "rmsprop":
         return optax.rmsprop(lr, decay=_pop(opts, "decay", default=0.9),
                              eps=_pop(opts, "epsilon", "eps", default=1e-10),

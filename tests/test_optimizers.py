@@ -253,3 +253,29 @@ def test_weight_decay_trains_toward_smaller_norms():
         norms[wd] = float(np.linalg.norm(flat))
         assert res.losses[-1] < res.losses[0]
     assert norms[0.3] < norms[0.0]
+
+
+def test_adam_mu_dtype_bf16_state_and_convergence():
+    """mu_dtype='bfloat16' halves the first-moment HBM; the state really is
+    bf16 and training still converges to the f32-state optimum."""
+    import jax
+    import jax.numpy as jnp
+
+    opt = build_optimizer("adam", 0.05, {"mu_dtype": "bfloat16"})
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    state = opt.init(params)
+    mu = jax.tree.leaves(state[0].mu)[0]
+    assert mu.dtype == jnp.bfloat16
+
+    target = jnp.asarray([1.0, -2.0, 0.5, 3.0])
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(lambda p: jnp.sum((p["w"] - target) ** 2))(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    for _ in range(200):
+        params, state = step(params, state)
+    np.testing.assert_allclose(np.asarray(params["w"]), np.asarray(target),
+                               atol=1e-2)
