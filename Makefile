@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench baseline examples native clean
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean
 
 test:
 	python -m pytest tests/ -q
@@ -20,6 +20,12 @@ bench:
 
 bench-quick:
 	python bench.py --quick
+
+bench-ladder:
+	python benchmarks/run_all.py
+
+mfu-sweep:
+	python benchmarks/mfu_sweep.py
 
 baseline:
 	python bench_baseline.py
