@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 from collections import OrderedDict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -142,8 +143,7 @@ def _cached_quantized_params(model, graph_weights: str, quantize: str):
         [(scope, sorted(leaves)) for scope, leaves in
          model.param_specs().items()]).encode()).hexdigest()[:16]
     if graph_weights.startswith("npz:"):
-        import os as _os
-        st = _os.stat(graph_weights[4:])
+        st = os.stat(graph_weights[4:])
         key = f"{naming}:{graph_weights}:{st.st_mtime_ns}:{st.st_size}"
     else:
         key = (naming + ":"

@@ -98,26 +98,20 @@ def quantize_for_serving(model, params, mode: str = "weight_only",
                          min_size: int = 4096):
     """Shared implementation behind the model families'
     ``quantize_for_serving``: validate, set the model's ``quant_mode``,
-    return the quantized tree. Warns when NO leaf quantized — naming
-    conventions the matcher doesn't know (e.g. TF1 graphs with variables
-    named 'W1'/'weights', or everything under ``min_size``) would otherwise
-    silently serve full precision while the caller believes it's int8."""
+    return the quantized tree (``quantize_params`` warns if nothing
+    matched)."""
     if mode not in MODES:
         raise ValueError(f"quant mode must be one of {MODES}, got {mode!r}")
     model.quant_mode = mode
     return quantize_params(params, min_size=min_size)
 
 
-def _is_matmul_kernel(path_leaf: str, arr) -> bool:
-    # 'kernel' (graphdef dense / classifier head) or the transformer
-    # family's named projections ('qkv_kernel', 'o_kernel', 'fc1_kernel', ...)
+def _is_quantizable_kernel(path_leaf: str, arr) -> bool:
+    # 'kernel' (graphdef dense/conv2d, the classifier head) or the
+    # transformer family's named projections ('qkv_kernel', 'o_kernel',
+    # 'fc1_kernel', ...); 2-D matmul or 4-D conv kernels
     return ((path_leaf == "kernel" or path_leaf.endswith("_kernel"))
-            and getattr(arr, "ndim", 0) == 2)
-
-
-def _is_conv_kernel(path_leaf: str, arr) -> bool:
-    return ((path_leaf == "kernel" or path_leaf.endswith("_kernel"))
-            and getattr(arr, "ndim", 0) == 4)
+            and getattr(arr, "ndim", 0) in (2, 4))
 
 
 def quantize_params(params: Dict[str, Dict[str, Any]],
@@ -148,8 +142,7 @@ def quantize_params(params: Dict[str, Dict[str, Any]],
                 out[name] = qlayer(arr)
                 continue
             size = int(np_size(arr))
-            if ((_is_matmul_kernel(name, arr) or _is_conv_kernel(name, arr))
-                    and size >= min_size):
+            if _is_quantizable_kernel(name, arr) and size >= min_size:
                 q8, scale = quantize_tensor(arr, axis=-1)  # per out-channel
                 out[f"{name}_q8"] = q8
                 out[f"{name}_scale"] = scale
