@@ -109,7 +109,8 @@ class Trainer:
                  straggler_callback: Optional[Callable] = None,
                  metrics=None,
                  param_sharding: Union[str, None, dict] = "auto",
-                 rng_impl: Optional[str] = None):
+                 rng_impl: Optional[str] = None,
+                 halt_on_nan: bool = False):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -150,6 +151,13 @@ class Trainer:
         # training step — dropout-heavy transformers reclaim it. None keeps
         # JAX's default threefry stream (bit-reproducible with prior rounds).
         self.rng_impl = rng_impl
+        # divergence detection: a non-finite epoch loss always WARNS
+        # (post-hoc on the fused path); halt_on_nan=True additionally stops
+        # the fit at that epoch, returning the state from before the NaN
+        # update propagated further — it joins verbose/loss_callback/
+        # checkpointing in the needs-per-epoch-host-control set, so setting
+        # it takes the loop path instead of the single-dispatch fused one
+        self.halt_on_nan = halt_on_nan
         self.params = None
         self._epoch_cache = {}  # (batch, num_batches, mode, shuffle) -> compiled epoch
         # step-level checkpoint/resume — a capability upgrade over the
@@ -378,7 +386,8 @@ class Trainer:
         # generated exactly like the loop below, so losses match it.
         k = total_epochs - start_epoch
         if (k > 1 and not self.verbose and self.loss_callback is None
-                and ckpt_mgr is None and not self.straggler_factor):
+                and ckpt_mgr is None and not self.straggler_factor
+                and not self.halt_on_nan):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
                     pspecs is not None)
@@ -400,6 +409,7 @@ class Trainer:
             per_epoch = num_batches * batch if mode == "stochastic" else n
             self.params = params
             epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
+            self._warn_non_finite(epoch_losses)
             return TrainResult(params, epoch_losses,
                                per_epoch * k / max(wall, 1e-9), wall)
 
@@ -416,6 +426,7 @@ class Trainer:
         from .utils.preempt import NullGuard, PreemptionGuard
         guard = PreemptionGuard() if ckpt_mgr is not None else NullGuard()
         preempted = False
+        nan_halted = False
         with guard:
           while True:
             try:
@@ -449,8 +460,20 @@ class Trainer:
                                                              *device_args, erng)
                         loss_by_it[it] = jnp.mean(losses)
                         ran += 1
+                        needs_loss_val = (self.halt_on_nan or self.verbose
+                                          or self.loss_callback is not None)
+                        loss_val = (float(loss_by_it[it])  # ONE device sync
+                                    if needs_loss_val else None)
+                        if self.halt_on_nan and not np.isfinite(loss_val):
+                            logger.error(
+                                "non-finite loss %r at epoch %d: halting "
+                                "(halt_on_nan=True); check the learning "
+                                "rate / input data, or resume from the "
+                                "last finite checkpoint", loss_val, it)
+                            nan_halted = True
+                            preempted = True  # reuse the clean-stop path
+                            break
                         if self.verbose or self.loss_callback is not None:
-                            loss_val = float(loss_by_it[it])  # device sync
                             if self.verbose:
                                 logger.info("iteration %d loss %f", it, loss_val)
                             self.metrics.scalar("train/loss", loss_val, step=it)
@@ -512,8 +535,25 @@ class Trainer:
         per_epoch = num_batches * batch if mode == "stochastic" else n
         seen = per_epoch * ran
         self.params = params
-        epoch_losses = [float(loss_by_it[k]) for k in sorted(loss_by_it)]
+        epoch_keys = sorted(loss_by_it)
+        epoch_losses = [float(loss_by_it[k]) for k in epoch_keys]
+        if not nan_halted:  # the halt already logged its own ERROR
+            self._warn_non_finite(epoch_losses, epoch_keys)
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
+
+    @staticmethod
+    def _warn_non_finite(epoch_losses, epoch_numbers=None):
+        """Post-hoc divergence warning. ``epoch_numbers`` labels each loss
+        with its REAL epoch (a resumed run's list starts mid-stream; list
+        positions would mislabel the divergence point)."""
+        nums = epoch_numbers or list(range(1, len(epoch_losses) + 1))
+        bad = [n for n, l in zip(nums, epoch_losses) if not np.isfinite(l)]
+        if bad:
+            logger.warning(
+                "training diverged: non-finite loss at epoch(s) %s (of %d "
+                "epochs run) — the returned weights are NaN-contaminated; "
+                "lower the learning rate or enable halt_on_nan",
+                bad[:5], len(epoch_losses))
 
     def fit_stream(self, row_iterator, init_params=None, queue_capacity: int = 8,
                    chunk: int = 1024, epochs: int = 1) -> TrainResult:
@@ -594,6 +634,7 @@ class Trainer:
 
         losses = []
         seen = 0
+        nan_halted = False
         it_count = start_step
         t0 = time.perf_counter()
         dummy_y = np.zeros((bs, 1), np.float32)
@@ -680,6 +721,15 @@ class Trainer:
                         losses.append(loss)
                         seen += n_real
                         it_count += 1
+                        # opt-in: costs a per-step device sync (the loop is
+                        # otherwise fully async), so only when requested
+                        if self.halt_on_nan and not np.isfinite(float(loss)):
+                            logger.error(
+                                "non-finite loss at stream step %d: halting "
+                                "(halt_on_nan=True)", it_count)
+                            nan_halted = True
+                            q.close()
+                            break
                         if self.loss_callback is not None:
                             self.loss_callback(float(loss), it_count, 0)
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
@@ -687,6 +737,8 @@ class Trainer:
                             ckpt_mgr.save(it_count, _ckpt_state(
                                 params, opt_state, it_count, rng))
                     feeder.join()
+                    if nan_halted:
+                        break
                 finally:
                     # always tear the queue down (drains and unblocks the feeder);
                     # without this a failing step would leak the native ring and
@@ -695,8 +747,10 @@ class Trainer:
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         self.params = params
-        return TrainResult(params, [float(l) for l in losses],
-                           seen / max(wall, 1e-9), wall)
+        step_losses = [float(l) for l in losses]
+        if not nan_halted:  # the halt already logged its own ERROR
+            self._warn_non_finite(step_losses)
+        return TrainResult(params, step_losses, seen / max(wall, 1e-9), wall)
 
     # -- conveniences -------------------------------------------------------
 

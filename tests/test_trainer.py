@@ -484,3 +484,38 @@ def test_rng_impl_rbg_trains_and_resumes(tmp_path):
                   checkpoint_every=1, verbose=1)
     with pytest.raises(ValueError, match="rng_impl"):
         tr3.fit(x, y)
+
+
+def test_divergence_detection(caplog):
+    """A diverging fit (lr absurdly high -> inf/NaN) always warns; with
+    halt_on_nan=True the loop stops at the first non-finite epoch instead
+    of training NaNs for the remaining epochs."""
+    import logging
+
+    import sparkflow_tpu.nn as nn
+
+    def model():
+        x = nn.placeholder([None, 8], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        h = nn.dense(x, 16, activation="relu")
+        out = nn.dense(h, 1, name="outer")
+        nn.mean_squared_error(y, out)
+
+    rs = np.random.RandomState(0)
+    x = (rs.rand(128, 8) * 100).astype(np.float32)
+    y = (rs.rand(128, 1) * 100).astype(np.float32)
+
+    kw = dict(optimizer="gradient_descent",
+              optimizer_options={"learning_rate": 1e6},
+              iters=8, mini_batch_size=64)
+
+    with caplog.at_level(logging.WARNING, logger="sparkflow_tpu"):
+        r = Trainer(build_graph(model), "x:0", "y:0", **kw).fit(x, y)
+    assert not np.isfinite(r.losses[-1])
+    assert any("diverged" in rec.message for rec in caplog.records)
+
+    r2 = Trainer(build_graph(model), "x:0", "y:0", halt_on_nan=True,
+                 **kw).fit(x, y)
+    # halted at the first non-finite epoch: strictly fewer epochs ran
+    assert len(r2.losses) < 8
+    assert not np.isfinite(r2.losses[-1])
