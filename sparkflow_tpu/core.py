@@ -101,19 +101,25 @@ def _step_body(loss_fn: Callable, optimizer: optax.GradientTransformation) -> Ca
     return step
 
 
-def _sharded_trace_guard(fn: Callable, mesh: Mesh) -> Callable:
+def _sharded_trace_guard(fn: Callable, mesh: Mesh, batch_axis: str = "dp",
+                         head_axis: str = "tp") -> Callable:
     """On a >1-device mesh, trace ``fn`` under
-    :func:`~sparkflow_tpu.ops.attention.force_xla_attention` — pallas custom
-    calls have no GSPMD partitioning rule, so sharded programs must take the
-    XLA blockwise attention path (single-device meshes keep the kernel)."""
+    :func:`~sparkflow_tpu.ops.attention.sharded_attention` — pallas custom
+    calls have no GSPMD partitioning rule, so sharded programs route
+    attention through a nested shard_map over (batch x heads) that runs the
+    kernel per shard; shapes that don't divide the mesh fall back to the
+    GSPMD-partitionable blockwise path inside flash_attention
+    (single-device meshes keep the plain kernel). The axis names must match
+    how the caller actually shards the batch/model."""
     if mesh.size <= 1:
         return fn
 
-    from .ops.attention import force_xla_attention
+    from .ops.attention import sharded_attention
 
     @functools.wraps(fn)
     def guarded(*args):
-        with force_xla_attention():
+        with sharded_attention(mesh, batch_axis=batch_axis,
+                               head_axis=head_axis):
             return fn(*args)
 
     return guarded

@@ -42,13 +42,12 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 # Trace-time switch: pallas_call lowers to a custom call that GSPMD has no
-# partitioning rule for, so under a sharded jit (dp/tp over a >1-device mesh)
-# the kernel's operands may be sharded and the compiled program would
-# replicate them (all-gather) or fail outright. The sharded train-step
-# builders trace under force_xla_attention() so attention takes the blockwise
-# XLA path, which GSPMD partitions cleanly. Running the pallas kernel
-# per-shard inside shard_map is the eventual perf path on real multi-chip
-# meshes; single-device jit keeps the kernel.
+# partitioning rule for, so under a sharded jit the kernel's operands may be
+# sharded and the compiled program would replicate them (all-gather) or fail
+# outright. Sharded train-step builders trace under sharded_attention()
+# (below), which keeps the kernel by nesting a shard_map; this explicit
+# override forces the GSPMD-partitionable blockwise path unconditionally —
+# for tests and for callers that need the partitioner to own attention.
 _FORCE_XLA: ContextVar[bool] = ContextVar("sparkflow_force_xla_attention",
                                           default=False)
 
@@ -63,6 +62,72 @@ def force_xla_attention():
         yield
     finally:
         _FORCE_XLA.reset(tok)
+
+
+# Sharded-jit attention: GSPMD cannot partition the pallas custom call, but
+# attention is embarrassingly parallel over batch and heads — so instead of
+# forfeiting the kernel on every >1-device mesh (the old blanket
+# force_xla_attention), sharded traces set this context and flash_attention
+# wraps ITSELF in a nested shard_map over (batch x heads), running the
+# pallas kernel per shard with zero communication. Falls back to the
+# blockwise path when the dims don't divide the mesh axes.
+_SHARD_ATTN: ContextVar = ContextVar("sparkflow_shard_attention",
+                                     default=None)
+
+
+@contextlib.contextmanager
+def sharded_attention(mesh, batch_axis: str = "dp", head_axis: str = "tp"):
+    """Within this context (including jit tracing started inside it),
+    :func:`flash_attention` runs the pallas kernel per (batch, heads) shard
+    via shard_map over ``mesh`` instead of degrading to XLA blockwise."""
+    tok = _SHARD_ATTN.set((mesh, batch_axis, head_axis))
+    try:
+        yield
+    finally:
+        _SHARD_ATTN.reset(tok)
+
+
+def _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
+                        block_q=None, block_k=None):
+    """shard_map-wrapped flash for sharded-jit traces, or None when the
+    context is unset / the shapes don't divide the mesh axes."""
+    ctx = _SHARD_ATTN.get()
+    if ctx is None:
+        return None
+    mesh, ba, ha = ctx
+    bsz = int(mesh.shape.get(ba, 1))
+    hsz = int(mesh.shape.get(ha, 1))
+    b, h = q.shape[0], q.shape[1]
+    if bsz * hsz <= 1 or b % bsz or h % hsz:
+        return None
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    bspec = ba if bsz > 1 else None
+    hspec = ha if hsz > 1 else None
+    qkv_spec = P(bspec, hspec)
+
+    def inner(q, k, v, *m):
+        # the body must not recurse into the wrapper, and per-shard
+        # divisibility/tiling decisions are flash_attention's own;
+        # explicitly pinned tile sizes stay pinned per shard (the
+        # documented contract)
+        tok = _SHARD_ATTN.set(None)
+        try:
+            return flash_attention(q, k, v, causal=causal, sm_scale=scale,
+                                   interpret=interpret,
+                                   block_q=block_q, block_k=block_k,
+                                   kv_mask=m[0] if m else None)
+        finally:
+            _SHARD_ATTN.reset(tok)
+
+    in_specs = (qkv_spec, qkv_spec, qkv_spec)
+    args = (q, k, v)
+    if kv_mask is not None:
+        in_specs += (P(bspec),)
+        args += (kv_mask,)
+    return shard_map(inner, mesh=mesh, in_specs=in_specs,
+                     out_specs=qkv_spec, check_vma=False)(*args)
 
 
 # Which path the most recent flash_attention TRACE took ('pallas',
@@ -531,6 +596,7 @@ def flash_attention(q, k, v, causal: bool = False,
     b, h, s, d = q.shape
     sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    _user_block_q, _user_block_k = block_q, block_k  # pre-auto-derivation
 
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None:
@@ -547,8 +613,20 @@ def flash_attention(q, k, v, causal: bool = False,
     xla_block_k = min(block_k, 512)
     global _LAST_PATH
     if _FORCE_XLA.get():
-        # sharded-jit context: GSPMD can partition the blockwise path but not
-        # the pallas custom call
+        # explicit override (tests, callers that need the GSPMD-partitionable
+        # form): blockwise unconditionally
+        _LAST_PATH = "blockwise"
+        return _blockwise_attention(q, k, v, kv_mask, causal, scale,
+                                    block_k=xla_block_k)
+    wrapped = _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
+                                  block_q=_user_block_q, block_k=_user_block_k)
+    if wrapped is not None:
+        return wrapped
+    if _SHARD_ATTN.get() is not None:
+        # sharded-jit trace but the shapes don't divide the mesh's
+        # batch/heads axes (or the mesh has neither): the plain pallas call
+        # would hand GSPMD an unpartitionable custom call — blockwise is the
+        # partitionable form
         _LAST_PATH = "blockwise"
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)

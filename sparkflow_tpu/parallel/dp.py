@@ -1,12 +1,12 @@
-"""Data-parallel train step via shard_map: the pallas-kernel multi-chip path.
+"""Data-parallel train step via shard_map: the whole-step manual-SPMD form.
 
-``core.make_train_step``'s GSPMD jit must route attention to the blockwise
-XLA path because ``pallas_call`` has no SPMD partitioning rule
-(``ops.attention.force_xla_attention``). Inside :func:`jax.shard_map` every
-operand is the device-LOCAL shard, so the flash-attention kernels run
-per-device with no partitioner involved — this is the standard recipe for
-custom kernels on a mesh (scaling-book §sharding: map the kernel, let the
-collectives handle the rest).
+``core.make_train_step``'s GSPMD jit now keeps the flash kernel too — its
+trace runs under ``ops.attention.sharded_attention``, which nests a
+shard_map around just the attention op. This module is the WHOLE-STEP
+shard_map form: every operand is the device-LOCAL shard end to end, so all
+pallas kernels run per-device with no partitioner involved anywhere — the
+standard recipe for custom kernels on a mesh (scaling-book §sharding: map
+the kernel, let the collectives handle the rest).
 
 Semantics are identical to the GSPMD step: the loss is the global masked
 mean, gradients are ``psum``-reduced sums divided by the global example

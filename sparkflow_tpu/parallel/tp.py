@@ -72,7 +72,8 @@ def make_sharded_train_step(model, optimizer, mesh: Mesh, input_name: str,
     """
     loss_fn = make_loss_fn(model, input_name, label_name)
     from ..core import _sharded_trace_guard
-    step = _sharded_trace_guard(_step_body(loss_fn, optimizer), mesh)
+    step = _sharded_trace_guard(_step_body(loss_fn, optimizer), mesh,
+                                batch_axis=dp_axis)
     data = NamedSharding(mesh, P(dp_axis))
     repl = NamedSharding(mesh, P())
     return jax.jit(step,
