@@ -435,3 +435,25 @@ def test_sharded_jit_attention_runs_pallas_per_shard(dp_mesh):
     np.testing.assert_allclose(np.asarray(out2),
                                np.asarray(A.attention_reference(qo, qo, qo)),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_jit_attention_with_kv_mask(dp_mesh):
+    """The key-padding mask shards over the batch axis with q/k/v: masked
+    sharded-jit attention (the BERT attention_mask path on a mesh) matches
+    the reference bit-for-fp-tolerance."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from sparkflow_tpu.ops import attention as A
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "tp"))
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(4, 8, 128, 16), jnp.float32)
+    mask = jnp.asarray((rs.rand(4, 128) > 0.3).astype(np.float32))
+
+    with A.sharded_attention(mesh):
+        out = jax.jit(lambda q, m: A.flash_attention(q, q, q, kv_mask=m))(
+            q, mask)
+    ref = A.attention_reference(q, q, q, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
