@@ -50,3 +50,13 @@ def dp_mesh():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(12345)
+
+
+@pytest.fixture(scope="session")
+def sharded_attn_mesh():
+    """2x4 {dp, tp} mesh for the sharded-jit attention tests."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import jax
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))

@@ -396,17 +396,15 @@ def test_flash_short_query_cross_attention_keeps_kernel():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_sharded_jit_attention_runs_pallas_per_shard(dp_mesh):
+def test_sharded_jit_attention_runs_pallas_per_shard(sharded_attn_mesh):
     """Sharded-jit traces no longer forfeit the flash kernel: under
     sharded_attention(mesh) the kernel runs per (batch x heads) shard via a
     nested shard_map, numerics identical to the blockwise path it replaces;
     shapes that don't divide the mesh fall back to blockwise."""
     import jax.numpy as jnp
-    from jax.sharding import Mesh
     from sparkflow_tpu.ops import attention as A
 
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ("dp", "tp"))
+    mesh = sharded_attn_mesh
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.randn(4, 8, 128, 16), jnp.float32)  # b%2, h%4 divide
 
@@ -437,16 +435,15 @@ def test_sharded_jit_attention_runs_pallas_per_shard(dp_mesh):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_sharded_jit_attention_with_kv_mask(dp_mesh):
+def test_sharded_jit_attention_with_kv_mask(sharded_attn_mesh):
     """The key-padding mask shards over the batch axis with q/k/v: masked
-    sharded-jit attention (the BERT attention_mask path on a mesh) matches
-    the reference bit-for-fp-tolerance."""
+    sharded-jit attention (the BERT attention_mask path on a mesh) runs the
+    pallas kernel per shard — forward AND backward — and matches the
+    reference."""
     import jax.numpy as jnp
-    from jax.sharding import Mesh
     from sparkflow_tpu.ops import attention as A
 
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ("dp", "tp"))
+    mesh = sharded_attn_mesh
     rs = np.random.RandomState(4)
     q = jnp.asarray(rs.randn(4, 8, 128, 16), jnp.float32)
     mask = jnp.asarray((rs.rand(4, 128) > 0.3).astype(np.float32))
@@ -454,6 +451,19 @@ def test_sharded_jit_attention_with_kv_mask(dp_mesh):
     with A.sharded_attention(mesh):
         out = jax.jit(lambda q, m: A.flash_attention(q, q, q, kv_mask=m))(
             q, mask)
+    # the masked wrap must keep the kernel, not silently fall to blockwise
+    # (which also honors the mask and would match numerically)
+    assert A.last_attention_path() == "pallas"
     ref = A.attention_reference(q, q, q, kv_mask=mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+    # masked custom-vjp under shard_map (the has_mask backward kernels with
+    # sharded operands) — only tested unsharded elsewhere
+    with A.sharded_attention(mesh):
+        g = jax.jit(jax.grad(lambda q: A.flash_attention(
+            q, q, q, kv_mask=mask).sum()))(q)
+    gref = jax.grad(lambda q: A.attention_reference(
+        q, q, q, kv_mask=mask).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=2e-4, atol=2e-4)
