@@ -5,6 +5,11 @@ Token ids travel as a Spark vector column; with real pyspark, tokenize with
 Spark ML (`Tokenizer` + a vocab map) upstream — here synthetic ids keep the
 example self-contained. On TPU this runs bf16 with the pallas flash-attention
 kernel; CPU smoke mode shrinks the model.
+
+Round-4 surfaces: set ``SPARKFLOW_TPU_MESH="dp=2,tp=4"`` to train the same
+fit tensor-parallel from the Param surface (the sharded jit keeps the
+pallas kernel via a nested shard_map), and the fitted model also serves an
+int8-quantized transform for comparison.
 """
 
 import os
@@ -84,7 +89,12 @@ if __name__ == "__main__":
         # multi-input feed: the attention mask rides a second column into a
         # second graph tensor (train AND transform)
         extraInputCols="mask",
-        extraTfInputs="attention_mask:0")
+        extraTfInputs="attention_mask:0",
+        # optional multi-device mesh from the env (e.g. "dp=2,tp=4"); tp
+        # uses the model's megatron rules, and attention keeps the pallas
+        # kernel per shard
+        **({"meshShape": os.environ["SPARKFLOW_TPU_MESH"]}
+           if os.environ.get("SPARKFLOW_TPU_MESH") else {}))
 
     pipe = Pipeline(stages=[
         OneHotEncoder(inputCol="label", outputCol="labels", dropLast=False),
@@ -92,3 +102,10 @@ if __name__ == "__main__":
     preds = pipe.transform(df)
     acc = np.mean([float(r["predicted"]) == r["label"] for r in preds.collect()])
     print(f"train accuracy: {acc:.3f}")
+
+    # int8 serving: same fitted model, weights quantized executor-side
+    pipe.stages[-1].setParams(inferenceQuantize="weight_only")
+    qpreds = pipe.transform(df)
+    qacc = np.mean([float(r["predicted"]) == r["label"]
+                    for r in qpreds.collect()])
+    print(f"int8 (weight_only) serving accuracy: {qacc:.3f}")
