@@ -214,6 +214,7 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
     clip_norm = opts.pop("clip_norm", None)
     clip_value = opts.pop("clip_value", None)
     weight_decay = float(opts.pop("weight_decay", 0.0) or 0.0)
+    ema_decay = float(opts.pop("ema_decay", 0.0) or 0.0)
 
     base = _build_base_optimizer(optimizer_name, lr, opts)
     if weight_decay > 0.0:
@@ -243,6 +244,13 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
         # update, a no-op).
         base = optax.chain(base, optax.scale_by_schedule(
             build_schedule(schedule)))
+    if ema_decay > 0.0:
+        # OUTERMOST so the EMA tracks the post-update weights the run
+        # actually applies (after decay/clip/accumulation/schedule); the
+        # wrapper itself skips the zero-update mini-steps accumulation
+        # emits, so the decay means per APPLIED update regardless of
+        # grad_accum_steps.
+        base = _with_weight_ema(base, ema_decay)
     return base
 
 
@@ -263,6 +271,76 @@ def _with_decoupled_decay(inner: optax.GradientTransformation,
         return u, s
 
     return optax.GradientTransformation(init, update)
+
+
+class WeightEmaState(NamedTuple):
+    inner: Any
+    ema: optax.Params
+    count: jax.Array
+    decay: jax.Array  # baked into state so extraction needs no config
+
+
+def _with_weight_ema(inner: optax.GradientTransformation,
+                     decay: float) -> optax.GradientTransformation:
+    """Maintain an exponential moving average of the POST-update weights in
+    optimizer state (Polyak averaging — the standard serving-quality
+    upgrade). ``extract_ema_params(opt_state)`` recovers the debiased
+    averaged tree; the training weights themselves are untouched."""
+    def init(params):
+        return WeightEmaState(inner=inner.init(params),
+                              ema=jax.tree.map(jnp.zeros_like, params),
+                              count=jnp.zeros((), jnp.int32),
+                              decay=jnp.asarray(decay, jnp.float32))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("ema_decay needs params at update time")
+        u, s = inner.update(updates, state.inner, params)
+        new_p = optax.apply_updates(params, u)
+        # Blend only on mini-steps whose applied update is nonzero: under
+        # grad accumulation MultiSteps emits zero updates between
+        # boundaries, and blending toward unchanged params on those would
+        # shrink the configured averaging horizon by the accumulation
+        # factor. (An exactly-zero REAL update also skips — measure-zero in
+        # fp training and harmless: ema would blend toward params it
+        # already tracks.)
+        changed = jnp.asarray(
+            sum(jnp.sum(jnp.abs(x)) for x in jax.tree.leaves(u)) > 0,
+            jnp.float32)
+        d_eff = 1.0 - (1.0 - state.decay) * changed
+        ema = jax.tree.map(
+            lambda e, p: d_eff * e + (1.0 - d_eff) * p, state.ema, new_p)
+        return u, WeightEmaState(inner=s, ema=ema,
+                                 count=state.count + changed.astype(jnp.int32),
+                                 decay=state.decay)
+
+    return optax.GradientTransformation(init, update)
+
+
+def extract_ema_params(opt_state):
+    """The debiased EMA weight tree from an ``ema_decay``-enabled optimizer
+    state, or None when EMA isn't enabled. Searches through wrapper states
+    (MultiSteps, chains) for the :class:`WeightEmaState`; debiasing divides
+    by ``1 - decay^count`` (the zeros-init underestimate, like Adam's
+    moment correction)."""
+    def find(s):
+        if isinstance(s, WeightEmaState):
+            return s
+        if isinstance(s, (tuple, list)):  # optax wrapper states are all
+            for child in s:               # NamedTuples — tuple traversal
+                got = find(child)         # covers them
+                if got is not None:
+                    return got
+        return None
+
+    st = find(opt_state)
+    if st is None or int(st.count) == 0:
+        # never updated (e.g. a zero-epoch fit): the zeros-init ema would
+        # debias to an all-zeros weight tree — None matches the documented
+        # "not populated" contract instead of serving garbage
+        return None
+    corr = 1.0 - jnp.power(st.decay, st.count.astype(jnp.float32))
+    return jax.tree.map(lambda e: e / jnp.maximum(corr, 1e-12), st.ema)
 
 
 def build_schedule(cfg) -> optax.Schedule:

@@ -159,6 +159,7 @@ class Trainer:
         # it takes the loop path instead of the single-dispatch fused one
         self.halt_on_nan = halt_on_nan
         self.params = None
+        self._last_opt_state = None
         self._epoch_cache = {}  # (batch, num_batches, mode, shuffle) -> compiled epoch
         # step-level checkpoint/resume — a capability upgrade over the
         # reference's save-at-end-only persistence (SURVEY.md §5)
@@ -408,6 +409,7 @@ class Trainer:
             wall = time.perf_counter() - t0
             per_epoch = num_batches * batch if mode == "stochastic" else n
             self.params = params
+            self._last_opt_state = opt_state
             epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
             self._warn_non_finite(epoch_losses)
             return TrainResult(params, epoch_losses,
@@ -535,11 +537,22 @@ class Trainer:
         per_epoch = num_batches * batch if mode == "stochastic" else n
         seen = per_epoch * ran
         self.params = params
+        self._last_opt_state = opt_state
         epoch_keys = sorted(loss_by_it)
         epoch_losses = [float(loss_by_it[k]) for k in epoch_keys]
         if not nan_halted:  # the halt already logged its own ERROR
             self._warn_non_finite(epoch_losses, epoch_keys)
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
+
+    def ema_weights(self):
+        """The debiased Polyak-averaged weight tree from the last fit, when
+        the optimizer was built with the ``ema_decay`` config key; None
+        otherwise. Serve these instead of the raw final weights for the
+        usual EMA quality bump."""
+        if self._last_opt_state is None:
+            return None
+        from .optimizers import extract_ema_params
+        return extract_ema_params(self._last_opt_state)
 
     @staticmethod
     def _warn_non_finite(epoch_losses, epoch_numbers=None):
@@ -747,6 +760,7 @@ class Trainer:
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
         self.params = params
+        self._last_opt_state = opt_state
         step_losses = [float(l) for l in losses]
         if not nan_halted:  # the halt already logged its own ERROR
             self._warn_non_finite(step_losses)
