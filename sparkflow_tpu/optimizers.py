@@ -245,12 +245,17 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
         base = optax.chain(base, optax.scale_by_schedule(
             build_schedule(schedule)))
     if ema_decay > 0.0:
+        if not (0.0 < ema_decay < 1.0):
+            # 1.0 would freeze the zeros-init average (and debias it into
+            # an all-zeros tree); >1 diverges — fail at build, not at serve
+            raise ValueError(
+                f"ema_decay must be in (0, 1), got {ema_decay}")
         # OUTERMOST so the EMA tracks the post-update weights the run
-        # actually applies (after decay/clip/accumulation/schedule); the
-        # wrapper itself skips the zero-update mini-steps accumulation
-        # emits, so the decay means per APPLIED update regardless of
-        # grad_accum_steps.
-        base = _with_weight_ema(base, ema_decay)
+        # actually applies (after decay/clip/accumulation/schedule); under
+        # accumulation the wrapper skips the zero-update mini-steps, so
+        # the decay means per APPLIED update regardless of grad_accum_steps
+        base = _with_weight_ema(base, ema_decay,
+                                skip_zero_updates=accum > 1)
     return base
 
 
@@ -281,7 +286,9 @@ class WeightEmaState(NamedTuple):
 
 
 def _with_weight_ema(inner: optax.GradientTransformation,
-                     decay: float) -> optax.GradientTransformation:
+                     decay: float,
+                     skip_zero_updates: bool = False
+                     ) -> optax.GradientTransformation:
     """Maintain an exponential moving average of the POST-update weights in
     optimizer state (Polyak averaging — the standard serving-quality
     upgrade). ``extract_ema_params(opt_state)`` recovers the debiased
@@ -297,16 +304,20 @@ def _with_weight_ema(inner: optax.GradientTransformation,
             raise ValueError("ema_decay needs params at update time")
         u, s = inner.update(updates, state.inner, params)
         new_p = optax.apply_updates(params, u)
-        # Blend only on mini-steps whose applied update is nonzero: under
-        # grad accumulation MultiSteps emits zero updates between
-        # boundaries, and blending toward unchanged params on those would
-        # shrink the configured averaging horizon by the accumulation
-        # factor. (An exactly-zero REAL update also skips — measure-zero in
-        # fp training and harmless: ema would blend toward params it
-        # already tracks.)
-        changed = jnp.asarray(
-            sum(jnp.sum(jnp.abs(x)) for x in jax.tree.leaves(u)) > 0,
-            jnp.float32)
+        if skip_zero_updates:
+            # Blend only on mini-steps whose applied update is nonzero:
+            # under grad accumulation MultiSteps emits zero updates between
+            # boundaries, and blending toward unchanged params on those
+            # would shrink the configured averaging horizon by the
+            # accumulation factor. (An exactly-zero REAL update also skips
+            # — measure-zero in fp training and harmless.) Without
+            # accumulation the gate can never fire, so the O(params)
+            # reduction is skipped entirely.
+            changed = jnp.asarray(
+                sum(jnp.sum(jnp.abs(x)) for x in jax.tree.leaves(u)) > 0,
+                jnp.float32)
+        else:
+            changed = jnp.ones((), jnp.float32)
         d_eff = 1.0 - (1.0 - state.decay) * changed
         ema = jax.tree.map(
             lambda e, p: d_eff * e + (1.0 - d_eff) * p, state.ema, new_p)

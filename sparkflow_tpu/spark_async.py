@@ -212,6 +212,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # "dp=2,fsdp=4", ...) so multi-strategy parallelism is reachable from the
     # Param surface; unset -> all local devices on one 'dp' axis
     meshShape = Param(Params._dummy(), "meshShape", "", typeConverter=TypeConverters.toString)
+    # upgrade: the fitted model stores the Polyak-averaged weights instead of
+    # the raw final ones; requires {'ema_decay': d} in optimizerOptions
+    useEmaWeights = Param(Params._dummy(), "useEmaWeights", "", typeConverter=TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self,
@@ -242,7 +245,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  fitMode=None,
                  extraInputCols=None,
                  extraTfInputs=None,
-                 meshShape=None):
+                 meshShape=None,
+                 useEmaWeights=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -259,7 +263,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          partitionShuffles=1, optimizerOptions=None, port=5000,
                          weightsPath=None, checkpointDir=None, checkpointEvery=0,
                          fitMode='collect', extraInputCols=None,
-                         extraTfInputs=None, meshShape=None)
+                         extraTfInputs=None, meshShape=None,
+                         useEmaWeights=False)
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -293,7 +298,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   fitMode=None,
                   extraInputCols=None,
                   extraTfInputs=None,
-                  meshShape=None):
+                  meshShape=None,
+                 useEmaWeights=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -410,6 +416,17 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 # axis makes e.g. "fsdp=8" mean "all devices shard params,
                 # none shard data" instead of a deep GSPMD error
                 mesh_axes = {"dp": 1, **mesh_axes}
+        if self.getOrDefault(self.useEmaWeights):
+            # fail BEFORE training, not after hours of fit: the EMA only
+            # exists when the optimizer maintains it
+            import json as _json
+            raw = self.getOptimizerOptions()
+            opts_d = (_json.loads(raw) if isinstance(raw, str) and raw
+                      else (raw or {}))
+            if not float(opts_d.get("ema_decay", 0) or 0):
+                raise ValueError(
+                    "useEmaWeights=True requires {'ema_decay': d} in "
+                    "optimizerOptions — no EMA would be maintained")
         # Documented no-ops (there is no parameter server): warn so a config
         # carried over from the reference states its own inertness instead of
         # silently passing (tests assert these warnings — the API contract is
@@ -490,12 +507,21 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             features, labels = handle_features(
                 items, is_supervised=label_col is not None)
             result = trainer.fit(features, labels)
+        final_weights = trainer.weights_list()
+        if self.getOrDefault(self.useEmaWeights):
+            ema = trainer.ema_weights()
+            if ema is None:
+                raise ValueError(
+                    "useEmaWeights=True requires {'ema_decay': d} in "
+                    "optimizerOptions (no EMA was maintained this fit)")
+            from .graphdef import params_to_list
+            final_weights = params_to_list(trainer.model, ema)
         weights_path = self.getOrDefault(self.weightsPath)
         if weights_path:
             if not weights_path.endswith(".npz"):
                 weights_path += ".npz"
             from .model_loader import save_weights_npz
-            save_weights_npz(weights_path, trainer.weights_list())
+            save_weights_npz(weights_path, final_weights)
             # NOTE: the model stores this PATH, not the weights — unlike the
             # reference's self-contained inline JSON, the file must be visible
             # to every executor/machine that transforms or loads the pipeline
@@ -506,7 +532,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 "pipelines", weights_path)
             weights_json = "npz:" + weights_path
         else:
-            weights_json = convert_weights_to_json(trainer.weights_list())
+            weights_json = convert_weights_to_json(final_weights)
 
         return SparkAsyncDLModel(
             inputCol=inp_col,

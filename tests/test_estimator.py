@@ -573,3 +573,24 @@ def test_mesh_shape_ep_moe(spark):
     for a, b in zip(convert_json_to_weights(m_ep.getOrDefault(m_ep.modelWeights)),
                     convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
         np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_use_ema_weights(spark, gaussian_df):
+    """useEmaWeights: the fitted model stores the Polyak-averaged weights
+    (differs from the raw-final fit, still classifies); without ema_decay
+    configured it errors loudly instead of silently serving raw weights."""
+    import json
+
+    mg = build_graph(create_model)
+    opts = json.dumps({"learning_rate": 0.1, "ema_decay": 0.9})
+    m_ema = base_estimator(mg, iters=15, optimizerOptions=opts,
+                           useEmaWeights=True).fit(gaussian_df)
+    m_raw = base_estimator(mg, iters=15, optimizerOptions=opts).fit(gaussian_df)
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    w_ema = convert_json_to_weights(m_ema.getOrDefault(m_ema.modelWeights))
+    w_raw = convert_json_to_weights(m_raw.getOrDefault(m_raw.modelWeights))
+    assert any(np.abs(a - b).max() > 1e-6 for a, b in zip(w_ema, w_raw))
+    assert calculate_errors(m_ema.transform(gaussian_df)) < 100
+
+    with pytest.raises(ValueError, match="ema_decay"):
+        base_estimator(mg, iters=2, useEmaWeights=True).fit(gaussian_df)

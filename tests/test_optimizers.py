@@ -400,3 +400,10 @@ def test_ema_zero_step_fit_returns_none():
     opt = build_optimizer("adam", 0.01, {"ema_decay": 0.95})
     state = opt.init({"w": jax.numpy.zeros((3,))})
     assert extract_ema_params(state) is None
+
+
+def test_ema_decay_range_validated():
+    with pytest.raises(ValueError, match="ema_decay"):
+        build_optimizer("adam", 0.01, {"ema_decay": 1.0})
+    with pytest.raises(ValueError, match="ema_decay"):
+        build_optimizer("adam", 0.01, {"ema_decay": 1.5})
