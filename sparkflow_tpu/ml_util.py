@@ -85,21 +85,30 @@ _PREDICT_CACHE_MAX = 32
 
 def _cached_predict_fn(graph_json: str, tf_output: str, tf_input,
                        tf_dropout: Optional[str], dropout_value: float,
-                       quantize: Optional[str] = None):
+                       quantize: Optional[str] = None,
+                       mesh_axes: Optional[Dict[str, int]] = None):
     """Cache (model, predict_fn) across partitions — the reference rebuilt the
     whole session per partition (``ml_util.py:61-68``); one compiled program
     serves all partitions here. ``quantize`` ('weight_only'/'dynamic') keys
-    separately: the quantized program has a different params signature."""
+    separately (different params signature), as does ``mesh_axes`` (a
+    mesh-sharded program: batch over 'dp', attention per shard)."""
     digest = hashlib.sha256(graph_json.encode()).hexdigest()
     in_key = (tuple(tf_input) if isinstance(tf_input, (list, tuple))
               else tf_input)
-    key = (digest, tf_output, in_key, tf_dropout, dropout_value, quantize)
+    mesh_key = tuple(sorted(mesh_axes.items())) if mesh_axes else None
+    key = (digest, tf_output, in_key, tf_dropout, dropout_value, quantize,
+           mesh_key)
     if key not in _PREDICT_CACHE:
         from .models import model_from_json
         model = model_from_json(graph_json)
         if quantize:
             model.quant_mode = quantize
-        fn = make_predict_fn(model, tf_input, tf_output, tf_dropout, dropout_value)
+        mesh = None
+        if mesh_axes:
+            from .parallel.mesh import make_mesh
+            mesh = make_mesh(dict(mesh_axes))
+        fn = make_predict_fn(model, tf_input, tf_output, tf_dropout,
+                             dropout_value, mesh=mesh)
         _PREDICT_CACHE[key] = (model, fn)
         while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
             _PREDICT_CACHE.popitem(last=False)
@@ -163,12 +172,14 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
                  tf_dropout: Optional[str] = None, to_keep_dropout: bool = False,
                  chunk_size: int = 4096, extra_cols: Optional[List[str]] = None,
                  extra_inputs: Optional[List[str]] = None,
-                 quantize: Optional[str] = None) -> List:
+                 quantize: Optional[str] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None) -> List:
     """Per-partition inference (same signature/meaning as
     ``sparkflow/ml_util.py:54``). ``activation`` is the output tensor name.
     ``extra_cols``/``extra_inputs`` feed additional columns to additional
     tensors (multi-input models, e.g. an attention mask). ``quantize``
-    serves int8 weights ('weight_only' or 'dynamic', ``utils/quant.py``)."""
+    serves int8 weights ('weight_only' or 'dynamic', ``utils/quant.py``);
+    ``mesh_axes`` (e.g. ``{'dp': 8}``) serves over a device mesh."""
     if bool(extra_cols) != bool(extra_inputs) or (
             extra_cols and len(extra_cols) != len(extra_inputs)):
         raise ValueError("extra_cols and extra_inputs must pair up one-to-one")
@@ -178,7 +189,7 @@ def predict_func(rows: Iterable, graph_json: str, prediction: str,
     dropout_v = 1.0 if (tf_dropout is not None and to_keep_dropout) else 0.0
     names = [tf_input] + list(extra_inputs) if extra_cols else tf_input
     model, fn = _cached_predict_fn(graph_json, activation, names,
-                                   tf_dropout, dropout_v, quantize)
+                                   tf_dropout, dropout_v, quantize, mesh_axes)
     if quantize:
         params = _cached_quantized_params(model, graph_weights, quantize)
     else:

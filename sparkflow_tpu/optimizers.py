@@ -244,10 +244,11 @@ def build_optimizer(optimizer_name: str, learning_rate: Optional[float] = None,
         # update, a no-op).
         base = optax.chain(base, optax.scale_by_schedule(
             build_schedule(schedule)))
-    if ema_decay > 0.0:
+    if ema_decay:  # any nonzero value validates — including sign typos
         if not (0.0 < ema_decay < 1.0):
             # 1.0 would freeze the zeros-init average (and debias it into
-            # an all-zeros tree); >1 diverges — fail at build, not at serve
+            # an all-zeros tree); >1 or negative diverges — fail at build,
+            # not after a full fit
             raise ValueError(
                 f"ema_decay must be in (0, 1), got {ema_decay}")
         # OUTERMOST so the EMA tracks the post-update weights the run

@@ -98,6 +98,10 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
     # weights stay full-precision in the persisted Params — quantization
     # happens executor-side at serve time (utils/quant.py)
     inferenceQuantize = Param(Params._dummy(), "inferenceQuantize", "", typeConverter=TypeConverters.toString)
+    # upgrade: serve over a device mesh ("dp=8"): the batch shards over dp
+    # (data-parallel inference only — params serve replicated); unset ->
+    # single default device (reference-shaped executor-local inference)
+    meshShape = Param(Params._dummy(), "meshShape", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -111,13 +115,14 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                  predictionCol=None,
                  extraInputCols=None,
                  extraTfInputs=None,
-                 inferenceQuantize=None):
+                 inferenceQuantize=None,
+                 meshShape=None):
         super(SparkAsyncDLModel, self).__init__()
         self._setDefault(modelJson=None, inputCol='encoded',
                          predictionCol='predicted', tfOutput=None, tfInput=None,
                          modelWeights=None, tfDropout=None, toKeepDropout=False,
                          extraInputCols=None, extraTfInputs=None,
-                         inferenceQuantize=None)
+                         inferenceQuantize=None, meshShape=None)
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -133,7 +138,8 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                   predictionCol=None,
                   extraInputCols=None,
                   extraTfInputs=None,
-                  inferenceQuantize=None):
+                  inferenceQuantize=None,
+                  meshShape=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -159,12 +165,36 @@ class SparkAsyncDLModel(Model, HasInputCol, HasPredictionCol, PysparkReaderWrite
                 raise ValueError(
                     "inferenceQuantize must be one of %s (or unset), got %r"
                     % (list(MODES), quantize))
+        mesh_shape = _opt_param(self, self.meshShape) or None
+        if mesh_shape:
+            from .parallel.mesh import parse_mesh_shape
+            mesh_axes = parse_mesh_shape(mesh_shape)
+            bad = [a_ for a_ in mesh_axes if a_ != "dp"]
+            if bad:
+                # inference shards the BATCH; params serve replicated, so a
+                # tp/fsdp/... axis would silently replicate compute instead
+                # of parallelizing it — refuse rather than mislead
+                raise ValueError(
+                    "Model meshShape serves data-parallel only ('dp=N'); "
+                    "axes %s are not inference strategies" % bad)
+            import jax as _jax
+            need = int(np.prod(list(mesh_axes.values())))
+            have = len(_jax.devices())
+            if need > have:
+                # fail on the DRIVER with a clear message, not as an opaque
+                # task failure inside mapPartitions at action time
+                raise ValueError(
+                    "Model meshShape %r needs %d devices; %d visible"
+                    % (mesh_shape, need, have))
+        else:
+            mesh_axes = None
         return dataset.rdd.mapPartitions(
             lambda x: predict_func(x, mod_json, out, mod_weights, inp, tf_output,
                                    tf_input, tf_dropout, to_keep_dropout,
                                    extra_cols=extra_cols or None,
                                    extra_inputs=extra_inputs or None,
-                                   quantize=quantize)).toDF()
+                                   quantize=quantize,
+                                   mesh_axes=mesh_axes)).toDF()
 
 
 class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
@@ -418,15 +448,17 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 mesh_axes = {"dp": 1, **mesh_axes}
         if self.getOrDefault(self.useEmaWeights):
             # fail BEFORE training, not after hours of fit: the EMA only
-            # exists when the optimizer maintains it
-            import json as _json
+            # exists when the optimizer maintains it (build_optimizer
+            # validates the range, incl. sign typos, also pre-fit)
             raw = self.getOptimizerOptions()
-            opts_d = (_json.loads(raw) if isinstance(raw, str) and raw
+            opts_d = (json.loads(raw) if isinstance(raw, str) and raw
                       else (raw or {}))
-            if not float(opts_d.get("ema_decay", 0) or 0):
+            d = float(opts_d.get("ema_decay", 0) or 0)
+            if not 0.0 < d < 1.0:
                 raise ValueError(
-                    "useEmaWeights=True requires {'ema_decay': d} in "
-                    "optimizerOptions — no EMA would be maintained")
+                    "useEmaWeights=True requires {'ema_decay': d} with "
+                    "0 < d < 1 in optimizerOptions — no EMA would be "
+                    "maintained (got %r)" % d)
         # Documented no-ops (there is no parameter server): warn so a config
         # carried over from the reference states its own inertness instead of
         # silently passing (tests assert these warnings — the API contract is

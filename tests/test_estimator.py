@@ -594,3 +594,33 @@ def test_use_ema_weights(spark, gaussian_df):
 
     with pytest.raises(ValueError, match="ema_decay"):
         base_estimator(mg, iters=2, useEmaWeights=True).fit(gaussian_df)
+
+
+def test_model_mesh_shape_transform(spark, gaussian_df):
+    """meshShape on the fitted Model: transform serves over a device mesh
+    (batch over dp) with predictions identical to single-device serving,
+    and composes with inferenceQuantize."""
+    mg = build_graph(create_model)
+    fitted = base_estimator(mg, iters=10).fit(gaussian_df)
+
+    base = [float(r["predicted"]) for r in fitted.transform(gaussian_df).collect()]
+    fitted.setParams(meshShape="dp=8")
+    mesh = [float(r["predicted"]) for r in fitted.transform(gaussian_df).collect()]
+    np.testing.assert_allclose(mesh, base, atol=1e-5)
+
+    fitted.setParams(inferenceQuantize="weight_only")
+    both = [float(r["predicted"]) for r in fitted.transform(gaussian_df).collect()]
+    assert np.max(np.abs(np.asarray(both) - np.asarray(base))) < 0.05
+
+
+def test_model_mesh_shape_validation(spark, gaussian_df):
+    """Model meshShape validates on the DRIVER: non-dp axes and oversubscribed
+    device counts refuse with clear messages, not executor task failures."""
+    mg = build_graph(create_model)
+    fitted = base_estimator(mg, iters=2).fit(gaussian_df)
+    fitted.setParams(meshShape="tp=4")
+    with pytest.raises(ValueError, match="data-parallel only"):
+        fitted.transform(gaussian_df)
+    fitted.setParams(meshShape="dp=64")
+    with pytest.raises(ValueError, match="devices"):
+        fitted.transform(gaussian_df)
