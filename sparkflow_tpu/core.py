@@ -339,11 +339,16 @@ def pad_to_batches(x: np.ndarray, batch_size: int,
 def make_predict_fn(model: GraphModel, input_name, output_name: str,
                     dropout_name: Optional[str] = None,
                     dropout_value: float = 1.0,
-                    mesh: Optional[Mesh] = None) -> Callable:
+                    mesh: Optional[Mesh] = None,
+                    infer_params: bool = False) -> Callable:
     """Jitted fixed-shape inference: ``predict(params, x) -> out``.
     ``input_name`` may be a sequence of names; ``x`` is then a tuple.
     With ``mesh``, the batch shards over 'dp'; arbitrary batch sizes are
-    padded to the axis size internally and trimmed on return."""
+    padded to the axis size internally and trimmed on return.
+    ``infer_params=True`` takes param shardings from the arrays themselves
+    (tp/fsdp-placed params serve IN PLACE) instead of pinning them
+    replicated — mirroring :func:`make_train_step`; without it a placed
+    tree is rejected at call time (jit sharding-mismatch error)."""
     multi = isinstance(input_name, (list, tuple))
     in_keys = ([n.split(":")[0] for n in input_name] if multi
                else [input_name.split(":")[0]])
@@ -360,7 +365,8 @@ def make_predict_fn(model: GraphModel, input_name, output_name: str,
     predict = _sharded_trace_guard(predict, mesh)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("dp"))
-    inner = jax.jit(predict, in_shardings=(repl, data), out_shardings=data)
+    pspec = None if infer_params else repl
+    inner = jax.jit(predict, in_shardings=(pspec, data), out_shardings=data)
     dp = mesh.shape["dp"]
 
     def padded_predict(params, x):

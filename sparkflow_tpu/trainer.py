@@ -778,6 +778,10 @@ class Trainer:
     def predict_fn(self, output_name: str, dropout_value: float = 1.0,
                    mesh=None) -> Callable:
         """``mesh=`` opts into dp-sharded batch inference (chunk sizes must
-        divide the dp axis); default stays single-device."""
+        divide the dp axis); default stays single-device. On a trainer whose
+        params carry tp/fsdp placements, the program infers those shardings
+        so the placed tree serves in place instead of all-gathering."""
+        infer = self._resolve_pspecs() is not None and mesh is not None
         return make_predict_fn(self.model, self.input_name, output_name,
-                               self.dropout_name, dropout_value, mesh=mesh)
+                               self.dropout_name, dropout_value, mesh=mesh,
+                               infer_params=infer)

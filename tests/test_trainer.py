@@ -519,3 +519,37 @@ def test_divergence_detection(caplog):
     # halted at the first non-finite epoch: strictly fewer epochs ran
     assert len(r2.losses) < 8
     assert not np.isfinite(r2.losses[-1])
+
+
+def test_sharded_params_serve_in_place():
+    """A tp-mesh-trained Trainer's predict_fn infers the params' own
+    shardings: the tp-placed tree serves without an all-gather and matches
+    the single-device fit's predictions."""
+    from sparkflow_tpu.core import predict_in_chunks
+    from sparkflow_tpu.models import build_registry_spec
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    spec = build_registry_spec("transformer_classifier", vocab_size=30,
+                               num_classes=2, hidden=32, num_layers=2,
+                               num_heads=4, mlp_dim=64, max_len=8,
+                               dropout=0.0)
+    rs = np.random.RandomState(7)
+    ids = rs.randint(0, 30, (64, 8)).astype(np.float32)
+    y = np.eye(2)[rs.randint(0, 2, 64)].astype(np.float32)
+    mesh = make_mesh({"dp": 2, "tp": 4})
+
+    tr = Trainer(spec, "input_ids", "y", optimizer="adam", iters=3,
+                 mini_batch_size=16, mesh=mesh, seed=0)
+    tr.fit(ids, y)
+    assert "tp" in str(tr.params["block_0"]["qkv_kernel"].sharding.spec)
+    out = np.asarray(predict_in_chunks(
+        tr.predict_fn("logits", mesh=mesh), tr.params, ids))
+    # the served tree STAYED tp-sharded (no silent re-replication)
+    assert "tp" in str(tr.params["block_0"]["qkv_kernel"].sharding.spec)
+
+    tr_s = Trainer(spec, "input_ids", "y", optimizer="adam", iters=3,
+                   mini_batch_size=16, seed=0)
+    tr_s.fit(ids, y)
+    ref = np.asarray(predict_in_chunks(
+        tr_s.predict_fn("logits"), tr_s.params, ids))
+    np.testing.assert_allclose(out, ref, atol=5e-4)
