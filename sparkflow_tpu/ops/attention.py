@@ -135,13 +135,18 @@ def _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
 # compiling their TPU step: a kernel edit that breaks the tile rules would
 # otherwise fall back silently and the suite would stay green while the
 # perf path quietly degraded (the round-2 (8,128)-tile regression).
-_LAST_PATH = None
+# A ContextVar (like _FORCE_XLA/_SHARD_ATTN) so an interleaved trace in
+# another thread cannot clobber the value between a caller's compile and
+# its last_attention_path() check.
+_LAST_PATH: ContextVar = ContextVar("sparkflow_last_attention_path",
+                                    default=None)
 
 
 def last_attention_path():
     """Path taken by the most recent :func:`flash_attention` call (at trace
-    time for jitted callers): 'pallas' | 'blockwise' | 'reference' | None."""
-    return _LAST_PATH
+    time for jitted callers) in this thread/context: 'pallas' | 'blockwise'
+    | 'reference' | None."""
+    return _LAST_PATH.get()
 
 
 # ---------------------------------------------------------------------------
@@ -611,11 +616,10 @@ def flash_attention(q, k, v, causal: bool = False,
     # in HBM — the pallas-tuned (VMEM-sized) auto block would inflate that
     # up to 8x, so the fallbacks cap at the scan's own tuned default
     xla_block_k = min(block_k, 512)
-    global _LAST_PATH
     if _FORCE_XLA.get():
         # explicit override (tests, callers that need the GSPMD-partitionable
         # form): blockwise unconditionally
-        _LAST_PATH = "blockwise"
+        _LAST_PATH.set("blockwise")
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)
     wrapped = _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
@@ -627,7 +631,7 @@ def flash_attention(q, k, v, causal: bool = False,
         # batch/heads axes (or the mesh has neither): the plain pallas call
         # would hand GSPMD an unpartitionable custom call — blockwise is the
         # partitionable form
-        _LAST_PATH = "blockwise"
+        _LAST_PATH.set("blockwise")
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)
     # TPU tiling: q-rows multiple of 8 (sublanes), k-cols multiple of 128
@@ -640,14 +644,14 @@ def flash_attention(q, k, v, causal: bool = False,
                 and d % 8 == 0)
     if not tiles_ok:
         if kv_mask is None:
-            _LAST_PATH = "reference"
+            _LAST_PATH.set("reference")
             return attention_reference(q, k, v, causal, scale)
         # blockwise keeps memory bounded when it tiles; its own fallback is
         # the dense reference path with the mask honored
-        _LAST_PATH = "blockwise"
+        _LAST_PATH.set("blockwise")
         return _blockwise_attention(q, k, v, kv_mask, causal, scale,
                                     block_k=xla_block_k)
-    _LAST_PATH = "pallas"
+    _LAST_PATH.set("pallas")
     return _flash(q, k, v, kv_mask, causal, scale, block_q, block_k,
                   bwd_block_q, bwd_block_k, interpret)
 

@@ -51,16 +51,21 @@ logger = logging.getLogger("sparkflow_tpu")
 
 
 
-def _ckpt_state(params, opt_state, step, rng):
+def _ckpt_state(params, opt_state, step, rng, *, rng_impl):
     """The checkpoint payload schema — single source of truth for every
     save/restore site in fit and fit_stream. Typed PRNG keys (rng_impl set)
-    checkpoint as their raw key data; _restore_rng re-wraps them."""
+    checkpoint as their raw key data; _restore_rng re-wraps them. The impl
+    NAME rides along as an ASCII uint8 array (orbax/npz-safe) so restore can
+    compare it exactly — 'rbg' and 'unsafe_rbg' have identical key-data
+    widths, so width alone cannot tell them apart."""
     import jax.dtypes
     if hasattr(rng, "dtype") and jax.dtypes.issubdtype(rng.dtype,
                                                        jax.dtypes.prng_key):
         rng = jax.random.key_data(rng)
+    impl = np.frombuffer((rng_impl or "threefry").encode(), dtype=np.uint8)
     return {"params": params, "opt_state": opt_state,
-            "epoch": np.int64(step), "rng": np.asarray(rng)}
+            "epoch": np.int64(step), "rng": np.asarray(rng),
+            "rng_impl": impl.copy()}
 
 
 class TrainResult:
@@ -267,13 +272,31 @@ class Trainer:
             return jax.random.key(self.seed, impl=self.rng_impl)
         return jax.random.PRNGKey(self.seed)
 
-    def _restore_rng(self, raw):
+    def _restore_rng(self, raw, saved_impl=None):
         """Inverse of _ckpt_state's key handling: re-wrap raw key data under
-        the configured impl. The key-data width identifies the impl that
-        saved the checkpoint (threefry: 2 uint32 words, rbg: 4), so a
-        mismatched ``rng_impl`` fails with an actionable error instead of a
-        raw shape error deep inside jax.random."""
+        the configured impl. ``saved_impl`` is the checkpoint's recorded impl
+        name (ASCII uint8 array) — compared exactly, so even same-width swaps
+        like 'rbg' vs 'unsafe_rbg' fail with an actionable error instead of
+        silently continuing on a different key stream. The key-data width
+        check remains as a backstop for pre-schema checkpoints."""
         raw = jnp.asarray(raw)
+        mine = self.rng_impl or "threefry"
+        if saved_impl is not None:
+            try:
+                theirs = np.asarray(saved_impl,
+                                    dtype=np.uint8).tobytes().decode()
+            except UnicodeDecodeError:
+                raise ValueError(
+                    "checkpoint rng_impl record is not valid ASCII — the "
+                    "checkpoint is corrupt; point checkpoint_dir at a fresh "
+                    "directory to restart the rng stream") from None
+            if theirs != mine:
+                raise ValueError(
+                    f"checkpoint was written under rng_impl={theirs!r} but "
+                    f"this trainer is configured with rng_impl={mine!r}: "
+                    f"resume with the matching rng_impl, or point "
+                    f"checkpoint_dir at a fresh directory to restart the "
+                    f"rng stream")
         expect = 4 if self.rng_impl in ("rbg", "unsafe_rbg") else 2
         got = raw.shape[-1] if raw.ndim else None
         if got != expect:
@@ -286,6 +309,36 @@ class Trainer:
         if self.rng_impl:
             return jax.random.wrap_key_data(raw, impl=self.rng_impl)
         return raw
+
+    def _ckpt_restore(self, ckpt_mgr, ckpt_like):
+        """``ckpt_mgr.restore`` with pre-schema back-compat: checkpoints
+        written before the ``rng_impl`` leaf existed fail a template restore
+        that includes it (orbax raises an opaque structure-mismatch error),
+        so retry without the leaf — _restore_rng's key-data width check then
+        covers the impl validation for those legacy checkpoints."""
+        try:
+            return ckpt_mgr.restore(like=ckpt_like)
+        except Exception as e:
+            # only fall back when the SAVED tree genuinely lacks the leaf —
+            # a new-schema checkpoint whose restore failed for a real reason
+            # (corruption, shape change) must surface its original error,
+            # not silently skip the exact-impl validation
+            try:
+                raw = ckpt_mgr.restore()
+            except Exception:
+                raise e
+            if not isinstance(raw, dict) or "rng_impl" in raw:
+                raise e
+            logger.warning(
+                "checkpoint in %s predates the rng_impl schema; restoring "
+                "without it (impl validated by key-data width only)",
+                self.checkpoint_dir)
+            # a templated re-read is required (not the raw dict): the
+            # template restores typed structure — opt_state NamedTuples
+            # come back as plain dicts on the untemplated path
+            legacy_like = {k: v for k, v in ckpt_like.items()
+                           if k != "rng_impl"}
+            return ckpt_mgr.restore(like=legacy_like)
 
     def fit(self, features, labels: Optional[np.ndarray] = None,
             init_params=None) -> TrainResult:
@@ -355,8 +408,8 @@ class Trainer:
             # host-side structural template, captured BEFORE any donation can
             # invalidate device buffers (restore-after-failure needs it)
             ckpt_like = jax.tree.map(
-                np.asarray, _ckpt_state(params, opt_state, 0, rng))
-            state = ckpt_mgr.restore(like=ckpt_like)
+                np.asarray, _ckpt_state(params, opt_state, 0, rng, rng_impl=self.rng_impl))
+            state = self._ckpt_restore(ckpt_mgr, ckpt_like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
@@ -366,7 +419,7 @@ class Trainer:
                     # the first compiled step after resume)
                     params = self._place_params(params, pspecs)
                 start_epoch = int(state["epoch"])
-                rng = self._restore_rng(state["rng"])
+                rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
@@ -444,7 +497,7 @@ class Trainer:
                             # checkpoint
                             at = max(it, start_epoch)
                             ckpt_mgr.save(
-                                at, _ckpt_state(params, opt_state, at, rng))
+                                at, _ckpt_state(params, opt_state, at, rng, rng_impl=self.rng_impl))
                             logger.warning(
                                 "preempted: checkpoint saved at epoch %d", at)
                             preempted = True
@@ -503,7 +556,7 @@ class Trainer:
                                 and (it % self.checkpoint_every == 0
                                      or it == total_epochs)):
                             ckpt_mgr.save(
-                                it, _ckpt_state(params, opt_state, it, rng))
+                                it, _ckpt_state(params, opt_state, it, rng, rng_impl=self.rng_impl))
                     if preempted:
                         break
                 break
@@ -513,7 +566,7 @@ class Trainer:
                 # pod-scale failure handling: restore the last checkpoint and
                 # keep training (the reference dropped the update and printed,
                 # HogwildSparkModel.py:68-92 — unacceptable per SURVEY.md §5)
-                state = (ckpt_mgr.restore(like=ckpt_like)
+                state = (self._ckpt_restore(ckpt_mgr, ckpt_like)
                          if retries_left > 0 else None)
                 if state is None:
                     raise
@@ -521,7 +574,7 @@ class Trainer:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
                 start_epoch = int(state["epoch"])
-                rng = self._restore_rng(state["rng"])
+                rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 # epochs past the restore point will re-run: drop their losses
                 loss_by_it = {k: v for k, v in loss_by_it.items()
                               if k <= start_epoch}
@@ -633,15 +686,15 @@ class Trainer:
             from .checkpoint import CheckpointManager
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
             like = jax.tree.map(
-                np.asarray, _ckpt_state(params, opt_state, 0, rng))
-            state = ckpt_mgr.restore(like=like)
+                np.asarray, _ckpt_state(params, opt_state, 0, rng, rng_impl=self.rng_impl))
+            state = self._ckpt_restore(ckpt_mgr, like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
                 if pspecs is not None:
                     params = self._place_params(params, pspecs)
                 start_step = int(state["epoch"])
-                rng = self._restore_rng(state["rng"])
+                rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 logger.info("fit_stream resumed weights from step %d",
                             start_step)
 
@@ -663,7 +716,8 @@ class Trainer:
                     # contract as the in-loop check
                     if ckpt_mgr is not None and not preempt_saved:
                         ckpt_mgr.save(it_count, _ckpt_state(
-                            params, opt_state, it_count, rng))
+                            params, opt_state, it_count, rng,
+                            rng_impl=self.rng_impl))
                         logger.warning("preempted: checkpoint saved at "
                                        "stream step %d", it_count)
                     break
@@ -713,7 +767,8 @@ class Trainer:
                             # caller's iterator factory re-pulls the source)
                             if ckpt_mgr is not None:
                                 ckpt_mgr.save(it_count, _ckpt_state(
-                                    params, opt_state, it_count, rng))
+                                    params, opt_state, it_count, rng,
+                                    rng_impl=self.rng_impl))
                                 preempt_saved = True
                             logger.warning("preempted: stopping stream at step "
                                            "%d", it_count)
@@ -748,7 +803,8 @@ class Trainer:
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and it_count % self.checkpoint_every == 0):
                             ckpt_mgr.save(it_count, _ckpt_state(
-                                params, opt_state, it_count, rng))
+                                params, opt_state, it_count, rng,
+                                rng_impl=self.rng_impl))
                     feeder.join()
                     if nan_halted:
                         break
@@ -777,10 +833,11 @@ class Trainer:
 
     def predict_fn(self, output_name: str, dropout_value: float = 1.0,
                    mesh=None) -> Callable:
-        """``mesh=`` opts into dp-sharded batch inference (chunk sizes must
-        divide the dp axis); default stays single-device. On a trainer whose
-        params carry tp/fsdp placements, the program infers those shardings
-        so the placed tree serves in place instead of all-gathering."""
+        """``mesh=`` opts into dp-sharded batch inference (batches of any
+        size are padded internally up to a dp multiple); default stays
+        single-device. On a trainer whose params carry tp/fsdp placements,
+        the program infers those shardings so the placed tree serves in
+        place instead of all-gathering."""
         infer = self._resolve_pspecs() is not None and mesh is not None
         return make_predict_fn(self.model, self.input_name, output_name,
                                self.dropout_name, dropout_value, mesh=mesh,

@@ -292,6 +292,76 @@ def test_fit_stream_checkpoints_and_resumes_weights(tmp_path):
     assert np.abs(w_resumed - w_after).max() < 0.1
 
 
+def test_resume_from_pre_schema_checkpoint(tmp_path):
+    """Back-compat: checkpoints written before the rng_impl leaf was added
+    (schema without it) still restore — the template-retry in _ckpt_restore
+    drops the missing leaf instead of surfacing orbax's opaque structure-
+    mismatch error."""
+
+    def m():
+        x = nn.placeholder([None, 3], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        nn.mean_squared_error(y, nn.dense(x, 1, name="out"))
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 3).astype(np.float32)
+    Y = rs.rand(64, 1).astype(np.float32)
+    ck = str(tmp_path / "legacy")
+
+    tr1 = Trainer(build_graph(m), "x:0", "y:0", iters=2, mini_batch_size=16,
+                  checkpoint_dir=ck, checkpoint_every=1)
+    tr1.fit(X, Y)
+
+    # strip the rng_impl leaf from the saved state -> pre-schema layout
+    from sparkflow_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager(ck)
+    step = mgr.latest_step()
+    state = mgr.restore()
+    assert "rng_impl" in state
+    legacy = {k: v for k, v in state.items() if k != "rng_impl"}
+    import shutil
+    shutil.rmtree(mgr._step_dir(step))
+    mgr.save(step, legacy)
+
+    tr2 = Trainer(build_graph(m), "x:0", "y:0", iters=4, mini_batch_size=16,
+                  checkpoint_dir=ck, checkpoint_every=1)
+    r2 = tr2.fit(X, Y)  # must resume (epochs 3-4), not crash
+    assert len(r2.losses) >= 2
+    assert all(np.isfinite(l) for l in r2.losses)
+
+
+def test_fit_stream_rbg_checkpoint_resumes(tmp_path):
+    """fit_stream's save sites stamp the checkpoint with the trainer's real
+    rng_impl (regression: they once stamped the 'threefry' default, making
+    every non-default streaming resume fail the exact-impl check)."""
+
+    def m():
+        x = nn.placeholder([None, 3], name="x")
+        y = nn.placeholder([None, 1], name="y")
+        nn.mean_squared_error(y, nn.dense(x, 1, name="out"))
+
+    rs = np.random.RandomState(0)
+    ck = str(tmp_path / "ck_rbg")
+    tr = Trainer(build_graph(m), "x:0", "y:0", mini_batch_size=16,
+                 rng_impl="rbg", checkpoint_dir=ck, checkpoint_every=3)
+    tr.fit_stream(iter([(rs.rand(3).astype(np.float32), 1.0)
+                        for _ in range(200)]))
+    w_after = np.asarray(tr.params["out/BiasAdd"]["kernel"]).copy()
+
+    # the saved state must be stamped with the trainer's REAL impl
+    from sparkflow_tpu.checkpoint import CheckpointManager
+    state = CheckpointManager(ck).restore()
+    assert np.asarray(state["rng_impl"],
+                      dtype=np.uint8).tobytes().decode() == "rbg"
+
+    tr2 = Trainer(build_graph(m), "x:0", "y:0", mini_batch_size=16,
+                  rng_impl="rbg", checkpoint_dir=ck, checkpoint_every=0)
+    tr2.fit_stream(iter([(rs.rand(3).astype(np.float32), 1.0)] * 16))
+    # really resumed: one tiny batch keeps params near tr's final weights
+    w_resumed = np.asarray(tr2.params["out/BiasAdd"]["kernel"])
+    assert np.abs(w_resumed - w_after).max() < 0.1
+
+
 def test_trainer_multi_input_tuple_features():
     """Trainer.fit with input_name as a list: features travel as a tuple
     (transformer fed input_ids + attention_mask)."""
@@ -484,6 +554,15 @@ def test_rng_impl_rbg_trains_and_resumes(tmp_path):
                   checkpoint_every=1, verbose=1)
     with pytest.raises(ValueError, match="rng_impl"):
         tr3.fit(x, y)
+
+    # SAME key-data width, different impl ('rbg' vs 'unsafe_rbg' are both 4
+    # words): the checkpoint's recorded impl name catches what the width
+    # check cannot — resuming must raise, not continue on a different stream
+    tr4 = Trainer(build_graph(model), "x:0", "y:0", iters=6,
+                  mini_batch_size=64, rng_impl="unsafe_rbg",
+                  checkpoint_dir=ckpt, checkpoint_every=1, verbose=1)
+    with pytest.raises(ValueError, match="unsafe_rbg"):
+        tr4.fit(x, y)
 
 
 def test_divergence_detection(caplog):
