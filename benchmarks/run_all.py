@@ -328,6 +328,69 @@ def bench_flash_long_context():
         _emit("flash_attention_long_context", tb / tf, "speedup_x", extra)
 
 
+def bench_ring_flash_long_context():
+    """Ring-flash sequence-parallel attention at 8k/16k GLOBAL context: the
+    sp training path's attention (K/V shards rotating over the ring, pallas
+    kernel per visit — ops/attention.py:ring_flash_attention). On one chip
+    the ring is a single hop; on a pod slice the same program spans ICI.
+    Emits per-chip tokens/sec so multi-chip runs compare per-chip
+    efficiency, not just scale. TPU-only; amortized over fresh inputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from sparkflow_tpu.ops import ring_flash_attention
+    from sparkflow_tpu.utils.flops import attention_flops, device_peak_flops
+
+    if jax.default_backend() != "tpu":
+        _emit("ring_flash_long_context", 0, "tokens_per_sec_per_chip",
+              {"skipped": "not on tpu"})
+        return
+    peak = device_peak_flops()
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    rs = np.random.RandomState(0)
+    seqs = (8192,) if QUICK else (8192, 16384)
+    for S in seqs:
+        B, H, D = 1, 8, 64
+        ITERS = 4
+
+        def inner(q, k, v):
+            o = ring_flash_attention(q, k, v, "sp", causal=True)
+            return jax.lax.psum(o.astype(jnp.float32).sum(), "sp")
+
+        ring = shard_map(inner, mesh=mesh,
+                         in_specs=(P(None, None, "sp"),) * 3,
+                         out_specs=P(), check_vma=False)
+
+        def _fresh():
+            return jax.block_until_ready(
+                jnp.asarray(rs.randn(ITERS, B, H, S, D), jnp.bfloat16))
+
+        @jax.jit
+        def many(xs):
+            def body(acc, q):
+                return acc + ring(q, q, q), None
+            out, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), xs)
+            return out
+
+        float(many(_fresh()))  # compile + warm
+        inp = _fresh()
+        t0 = time.perf_counter()
+        float(many(inp))
+        t = (time.perf_counter() - t0) / ITERS
+        fl = attention_flops(B, H, S, S, D, causal=True)
+        extra = {"seq": S, "ring_devices": n,
+                 "ring_flash_ms": round(t * 1e3, 2),
+                 "tflops_per_sec_per_chip": round(fl / t / n / 1e12, 2)}
+        if peak:
+            extra["kernel_util"] = round(fl / t / n / peak, 4)
+        _emit("ring_flash_long_context", round(B * S / t / n, 1),
+              "tokens_per_sec_per_chip", extra)
+
+
 def bench_stream_vs_collect(compute_dtype):
     """fitMode='stream' vs the collect path on the same CNN workload: the
     native batch ring assembles fixed-shape batches concurrently with device
@@ -528,6 +591,7 @@ def main():
     bench_bert_step(compute_dtype)
     bench_flash_attention()
     bench_flash_long_context()
+    bench_ring_flash_long_context()
     bench_stream_vs_collect(compute_dtype)
     bench_quantized_inference()
     bench_tokenizer()

@@ -59,7 +59,7 @@ if __name__ == '__main__':
         tfOutput='out/Sigmoid:0',
         tfOptimizer='adam',
         tfLearningRate=.001,
-        iters=10,
+        iters=2 if os.environ.get("SPARKFLOW_TPU_SMOKE") else 10,
         predictionCol='predicted',
         partitions=4,
         miniBatchSize=256,

@@ -68,7 +68,7 @@ if __name__ == '__main__':
         miniBatchSize=300,
         miniStochasticIters=-1,
         shufflePerIter=True,
-        iters=50,
+        iters=2 if os.environ.get("SPARKFLOW_TPU_SMOKE") else 50,
         partitions=4,
         tfLearningRate=.0001,
         predictionCol='predicted',

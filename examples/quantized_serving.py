@@ -50,7 +50,7 @@ def main():
     spark = SparkSession.builder.appName('quantized-serving').getOrCreate()
     rs = np.random.RandomState(0)
     rows = []
-    for _ in range(500):
+    for _ in range(100 if os.environ.get('SPARKFLOW_TPU_SMOKE') else 500):
         rows.append((1.0, Vectors.dense(rs.normal(0.8, 1.0, 32))))
         rows.append((0.0, Vectors.dense(rs.normal(-0.8, 1.0, 32))))
     df = spark.createDataFrame(rows, ['label', 'features'])
@@ -58,7 +58,7 @@ def main():
     fitted = SparkAsyncDL(
         inputCol='features', tensorflowGraph=build_graph(model),
         tfInput='x:0', tfLabel='y:0', tfOutput='outer/Sigmoid:0',
-        labelCol='label', tfLearningRate=.05, iters=15, miniBatchSize=128,
+        labelCol='label', tfLearningRate=.05, iters=3 if os.environ.get('SPARKFLOW_TPU_SMOKE') else 15, miniBatchSize=128,
         verbose=1).fit(df)
 
     def error_rate(m):

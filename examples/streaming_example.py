@@ -39,8 +39,9 @@ if __name__ == "__main__":
     # backend and fall back to CPU (same guard bench.py uses)
     from sparkflow_tpu.utils.hw import ensure_live_backend
     ensure_live_backend()
+    smoke = bool(os.environ.get("SPARKFLOW_TPU_SMOKE"))
     tr = Trainer(build_graph(model), "x:0", "y:0", mini_batch_size=256,
                  learning_rate=0.05)
-    res = tr.fit_stream(row_stream())
+    res = tr.fit_stream(row_stream(n_rows=2000 if smoke else 20000))
     print(f"steps: {len(res.losses)}  loss {res.losses[0]:.3f} -> "
           f"{res.losses[-1]:.3f}  throughput {int(res.examples_per_sec)} rows/s")

@@ -181,7 +181,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   shuffle: bool, mesh: Optional[Mesh] = None,
                   n_real: Optional[int] = None, _raw: bool = False,
                   infer_params: bool = False,
-                  _unroll_budget: Optional[int] = None) -> Callable:
+                  _unroll_budget: Optional[int] = None,
+                  step_fn: Optional[Callable] = None) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -198,6 +199,12 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
     tuple of arrays for multi-input models — of shape
     ``[num_batches*batch_size, ...]`` (already padded); labels may be a dummy
     array when unsupervised.
+
+    ``step_fn`` swaps the per-batch update for a strategy-specific one with
+    the same ``(params, opt_state, x, y, mask, rng) -> (params, opt_state,
+    loss)`` signature (the trainer's pp/sp paths run their dedicated step
+    builders inside this SAME shuffle/batching program, so strategy fits
+    see identical batch order); ``loss_fn`` is ignored when it is given.
     """
 
     def epoch(params, opt_state, data, labels, mask, rng):
@@ -241,7 +248,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
         xb = jax.tree.map(reshape_b, data_e)
         yb, mb = reshape_b(labels_e), reshape_b(mask_e)
         step_rngs = jax.random.split(rng, num_batches)
-        step = _step_body(loss_fn, optimizer)
+        step = step_fn if step_fn is not None else _step_body(loss_fn,
+                                                              optimizer)
 
         def body(carry, batch):
             params, opt_state = carry
@@ -284,7 +292,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
                         shuffle: bool, n_epochs: int,
                         mesh: Optional[Mesh] = None,
                         n_real: Optional[int] = None,
-                        infer_params: bool = False) -> Callable:
+                        infer_params: bool = False,
+                        step_fn: Optional[Callable] = None) -> Callable:
     """``n_epochs`` whole epochs as ONE compiled program (``lax.scan`` over
     the epoch body): a full ``fit`` becomes a single device dispatch.
 
@@ -302,7 +311,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
     """
     body = make_epoch_fn(loss_fn, optimizer, batch_size, num_batches, mode,
                          shuffle, n_real=n_real, _raw=True,
-                         _unroll_budget=n_epochs * num_batches)
+                         _unroll_budget=n_epochs * num_batches,
+                         step_fn=step_fn)
 
     def run(params, opt_state, data, labels, mask, erngs):
         def step(carry, erng):

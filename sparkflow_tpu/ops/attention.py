@@ -87,6 +87,20 @@ def sharded_attention(mesh, batch_axis: str = "dp", head_axis: str = "tp"):
         _SHARD_ATTN.reset(tok)
 
 
+@contextlib.contextmanager
+def unsharded_attention():
+    """Within this context (including jit tracing started inside it),
+    :func:`flash_attention` ignores any enclosing :func:`sharded_attention`
+    — for step builders that manage their OWN shard_map (pp/sp): their
+    bodies run per-shard already, and re-wrapping the kernel in a nested
+    shard_map over the same mesh axes would be invalid."""
+    tok = _SHARD_ATTN.set(None)
+    try:
+        yield
+    finally:
+        _SHARD_ATTN.reset(tok)
+
+
 def _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
                         block_q=None, block_k=None):
     """shard_map-wrapped flash for sharded-jit traces, or None when the

@@ -183,7 +183,8 @@ def _simulate_1f1b(P: int, M: int):
 
 def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
                        pp_axis: str = "pp", schedule: str = "gpipe",
-                       dp_axis: str = "dp", task: str = "classifier"):
+                       dp_axis: str = "dp", task: str = "classifier",
+                       _raw: bool = False):
     """Pipeline-parallel train step for the transformer families.
 
     Signature: ``step(pp_params, opt_state, ids, y, rng) ->
@@ -259,7 +260,11 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         ym = _mb_slice(y, m_idx, mb)
         pooled = jnp.mean(x, axis=1).astype(jnp.float32)
         logits = _dense(pooled, shared["head"]["kernel"], shared["head"]["bias"])
-        return jnp.mean(-jnp.sum(ym * jax.nn.log_softmax(logits, axis=-1), axis=-1))
+        # softmax_xent accepts one-hot [mb, C] or index [mb]/[mb, 1] labels
+        # (the estimator's scalar labelCol path) — a raw ym*log_softmax sum
+        # would silently broadcast index labels into a meaningless loss
+        from ..models.base import softmax_xent
+        return jnp.mean(softmax_xent(logits, ym))
 
     # ---- gpipe: every stage computes every tick, on microbatch (t - s) ----
 
@@ -487,10 +492,12 @@ def make_pp_train_step(model, optimizer, mesh: Mesh, n_microbatches: int = 1,
         pp_params = optax.apply_updates(pp_params, updates)
         return pp_params, opt_state, loss
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
+    # _raw hands back the traceable step for callers embedding it in their
+    # own compiled program (the trainer's epoch scan); default is jitted.
+    out = step if _raw else jax.jit(step, donate_argnums=(0, 1))
     # serial forward span in stage-times: the schedule's defining number
     # (for 1f1b the table length counts COMBINED fwd+bwd compute slots)
-    jitted.schedule_ticks = (M + n_stages - 1 if schedule == "gpipe"
-                             else _T_1f1b if schedule == "1f1b"
-                             else M * n_stages)
-    return jitted
+    out.schedule_ticks = (M + n_stages - 1 if schedule == "gpipe"
+                          else _T_1f1b if schedule == "1f1b"
+                          else M * n_stages)
+    return out

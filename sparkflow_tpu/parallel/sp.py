@@ -26,7 +26,7 @@ from jax import shard_map
 
 
 def make_sp_train_step(model, optimizer, mesh: Mesh, dp_axis: Optional[str] = "dp",
-                       sp_axis: str = "sp"):
+                       sp_axis: str = "sp", _raw: bool = False):
     """Jitted sequence-parallel LM train step.
 
     Signature: ``step(params, opt_state, ids, mask, rng) ->
@@ -79,4 +79,6 @@ def make_sp_train_step(model, optimizer, mesh: Mesh, dp_axis: Optional[str] = "d
         params = optax.apply_updates(params, updates)
         return params, new_opt, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    # _raw hands back the traceable step for callers embedding it in their
+    # own compiled program (the trainer's epoch scan); default is jitted.
+    return step if _raw else jax.jit(step, donate_argnums=(0, 1))

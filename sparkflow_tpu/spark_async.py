@@ -434,13 +434,12 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
         if mesh_shape:
             from .parallel.mesh import parse_mesh_shape
             mesh_axes = parse_mesh_shape(mesh_shape)  # raises on bad syntax
-            bad = [a_ for a_ in mesh_axes if a_ in ("sp", "pp")]
-            if bad:
+            if (("sp" in mesh_axes or "pp" in mesh_axes)
+                    and fit_mode == "stream"):
                 raise ValueError(
-                    "meshShape axes %s are not estimator strategies "
-                    "(sequence/pipeline parallelism need the dedicated step "
-                    "builders in sparkflow_tpu.parallel); the estimator "
-                    "trains dp/tp/fsdp/ep meshes" % bad)
+                    "meshShape axes sp/pp need fitMode='collect': their "
+                    "fixed-shape batch schedules stage the whole dataset "
+                    "(the Trainer refuses pp/sp in fit_stream)")
             if "dp" not in mesh_axes:
                 # the compiled epochs shard dataset rows over 'dp'; a size-1
                 # axis makes e.g. "fsdp=8" mean "all devices shard params,

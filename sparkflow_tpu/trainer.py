@@ -115,7 +115,9 @@ class Trainer:
                  metrics=None,
                  param_sharding: Union[str, None, dict] = "auto",
                  rng_impl: Optional[str] = None,
-                 halt_on_nan: bool = False):
+                 halt_on_nan: bool = False,
+                 pp_microbatches: Optional[int] = None,
+                 pp_schedule: str = "gpipe"):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -156,6 +158,11 @@ class Trainer:
         # training step — dropout-heavy transformers reclaim it. None keeps
         # JAX's default threefry stream (bit-reproducible with prior rounds).
         self.rng_impl = rng_impl
+        # pipeline-parallel fits ('pp' mesh axis): microbatches per batch
+        # (None = deepest power-of-two the per-replica batch divides) and
+        # schedule ('gpipe' | '1f1b' | 'sequential' — parallel/pp.py)
+        self.pp_microbatches = pp_microbatches
+        self.pp_schedule = pp_schedule
         # divergence detection: a non-finite epoch loss always WARNS
         # (post-hoc on the fused path); halt_on_nan=True additionally stops
         # the fit at that epoch, returning the state from before the NaN
@@ -199,13 +206,11 @@ class Trainer:
         (pure-dp meshes replicate params regardless)."""
         if self.mesh is None:
             return None
-        bad = [a_ for a_ in self.mesh.axis_names if a_ in ("sp", "pp")]
-        if bad:
-            raise ValueError(
-                f"Trainer fits train the dp/tp/fsdp/ep strategies; mesh "
-                f"axes {bad} need the dedicated step builders "
-                f"(parallel.sp.make_sp_train_step / "
-                f"parallel.pp.make_pp_train_step)")
+        if self._mesh_strategy() != "default":
+            # pp/sp fits derive their placements in fit() (pp: pp_pspecs on
+            # the stage layout; sp: replicated params), not from megatron/
+            # ZeRO rules; _strategy_task refuses an explicit user pytree
+            return None
         if self.param_sharding is None:
             return None
         if not isinstance(self.param_sharding, str):
@@ -234,6 +239,140 @@ class Trainer:
     def _place_params(self, params, pspecs):
         from .parallel.tp import shard_params
         return shard_params(params, self.mesh, pspecs)
+
+    # -- pp/sp strategy dispatch -------------------------------------------
+    # 'pp'/'sp' mesh axes train through the dedicated step builders
+    # (parallel.pp / parallel.sp) slotted into the SAME epoch machinery via
+    # its step_fn override, so strategy fits see identical shuffle/batch
+    # order to the default path — this is what makes them reachable from
+    # the estimator's meshShape Param (reference has no parallelism at all;
+    # SURVEY.md §2.3).
+
+    def _mesh_strategy(self) -> str:
+        if self.mesh is None:
+            return "default"
+        axes = self.mesh.axis_names
+        if "pp" in axes and "sp" in axes:
+            raise ValueError(
+                "a Trainer mesh cannot combine 'pp' and 'sp' axes; pick "
+                "one strategy per fit (pipeline xor sequence parallelism)")
+        if "pp" in axes:
+            bad = [a_ for a_ in axes if a_ not in ("pp", "dp")]
+            if bad:
+                raise ValueError(
+                    f"'pp' composes with 'dp' only; mesh also has {bad}")
+            return "pp"
+        if "sp" in axes:
+            bad = [a_ for a_ in axes if a_ not in ("sp", "dp")]
+            if bad:
+                raise ValueError(
+                    f"'sp' composes with 'dp' only; mesh also has {bad}")
+            return "sp"
+        return "default"
+
+    def _strategy_task(self, strategy: str) -> str:
+        """Validate the model/mesh/label combination for a pp or sp fit and
+        return the step-builder task ('classifier' | 'lm')."""
+        m = self.model
+        # pipeline stages / replicated sp params are placed by the strategy
+        # itself — an explicit user pytree cannot be honored, so refuse it
+        # loudly rather than silently replicating
+        if (self.param_sharding is not None
+                and not isinstance(self.param_sharding, str)):
+            raise ValueError(
+                "explicit param_sharding pytrees do not apply to pp/sp "
+                "strategy meshes (the strategy places its own params); "
+                "drop param_sharding or use a dp/tp/fsdp/ep mesh")
+        n_inputs = (len(self.input_name)
+                    if isinstance(self.input_name, (list, tuple)) else 1)
+        if strategy == "pp" and self.label_name is not None and n_inputs != 1:
+            raise ValueError(
+                "pp classifier fits take exactly one input tensor (the "
+                "token ids); the pipeline step has no attention-mask path — "
+                "extra inputs would be silently ignored, so refuse instead")
+        if n_inputs > 2:
+            raise ValueError(
+                f"{strategy} fits take at most (input_ids, attention_mask); "
+                f"got {n_inputs} input tensors")
+        if strategy == "pp":
+            if not (hasattr(m, "num_layers") and hasattr(m, "_block")):
+                raise ValueError(
+                    f"meshShape with a 'pp' axis trains the registry "
+                    f"transformer families (stage-shardable blocks); "
+                    f"{type(m).__name__} has no block structure to "
+                    f"pipeline — use dp/fsdp for nn-DSL graphs")
+            n_stages = self.mesh.shape["pp"]
+            if m.num_layers % n_stages:
+                raise ValueError(
+                    f"num_layers={m.num_layers} does not divide into "
+                    f"pp={n_stages} pipeline stages")
+            return "lm" if self.label_name is None else "classifier"
+        # sp: ring attention is causal-LM only (boundary-token exclusion
+        # is next-token-loss math; see parallel/sp.py docstring)
+        from .models.transformer import TransformerLM
+        if not isinstance(m, TransformerLM):
+            raise ValueError(
+                f"meshShape with an 'sp' axis trains causal LM registry "
+                f"models (ring attention over the sequence); "
+                f"{type(m).__name__} is not a TransformerLM family model")
+        if self.label_name is not None:
+            raise ValueError(
+                "'sp' fits are unsupervised next-token training "
+                "(tfLabel/label_name must be None)")
+        return "lm"
+
+    def _make_strategy_step(self, strategy: str, task: str, batch: int):
+        """The per-batch step_fn for the epoch machinery: wraps the pp/sp
+        builder's raw step under unsharded_attention (they run their own
+        shard_map; re-wrapping the kernel over the same axes is invalid)."""
+        from .ops.attention import unsharded_attention
+        from .parallel.mesh import mesh_axis_size
+        dp = mesh_axis_size(self.mesh, "dp")
+        if batch % max(dp, 1):
+            raise ValueError(
+                f"mini_batch_size={batch} must divide over the dp axis "
+                f"(size {dp}) for a {strategy} fit")
+        if strategy == "pp":
+            from .parallel.pp import make_pp_train_step
+            per_dp = batch // max(dp, 1)
+            M = self.pp_microbatches
+            if M is None:
+                # auto: deepest power-of-two microbatching the per-replica
+                # batch supports (bounds pipeline bubble at fixed memory)
+                M = next(m for m in (8, 4, 2, 1) if per_dp % m == 0)
+            elif per_dp % M:
+                raise ValueError(
+                    f"pp_microbatches={M} must divide the per-dp-replica "
+                    f"batch {per_dp}")
+            raw = make_pp_train_step(
+                self.model, self.optimizer, self.mesh, n_microbatches=M,
+                schedule=self.pp_schedule, task=task, _raw=True)
+
+            def step_fn(p, o, x, y, m, r):
+                ids = x[0] if isinstance(x, tuple) else x
+                # lm task consumes the attention mask as token loss weights
+                y_eff = (x[1] if task == "lm" and isinstance(x, tuple)
+                         else y)
+                with unsharded_attention():
+                    return raw(p, o, ids, y_eff, r)
+
+            return step_fn
+        from .parallel.sp import make_sp_train_step
+        sp = self.mesh.shape["sp"]
+        raw = make_sp_train_step(self.model, self.optimizer, self.mesh,
+                                 _raw=True)
+
+        def step_fn(p, o, x, y, m, r):
+            ids = x[0] if isinstance(x, tuple) else x
+            amask = x[1] if isinstance(x, tuple) else y  # y carries ones
+            if ids.shape[1] % sp:
+                raise ValueError(
+                    f"sequence length {ids.shape[1]} must divide the sp "
+                    f"axis (size {sp}) for ring attention")
+            with unsharded_attention():
+                return raw(p, o, ids, amask, r)
+
+        return step_fn
 
     def _dp_size(self) -> int:
         from .parallel.mesh import mesh_axis_size
@@ -369,7 +508,44 @@ class Trainer:
             if labels.ndim == 1:
                 labels = labels[:, None]
 
+        strategy = self._mesh_strategy()
+        task = self._strategy_task(strategy) if strategy != "default" else None
+        if strategy != "default":
+            # pp/sp steps have no padded-row masking: every batch must be
+            # all-real rows. Trim the dataset to whole batches (stochastic
+            # batches sample real rows only, so just the dp-rounding must
+            # fit inside n).
+            dp = self._dp_size()
+            bs = self.mini_batch_size
+            stoch = bool(self.mini_stochastic_iters
+                         and self.mini_stochastic_iters > 0)
+            if bs is None or bs <= 0 or bs >= n:
+                unit = dp
+            elif stoch:
+                unit = dp
+            else:
+                unit = -(-bs // dp) * dp  # the planned sweep batch
+            n_use = (n // unit) * unit
+            if n_use == 0:
+                raise ValueError(
+                    f"dataset of {n} rows is smaller than one {strategy} "
+                    f"batch ({unit} rows)")
+            if n_use != n:
+                logger.warning(
+                    "%s fit drops the %d-row remainder (pp/sp steps carry "
+                    "no padded-row masking); a miniBatchSize dividing %d "
+                    "trains on every row", strategy, n - n_use, n)
+                n = n_use
+                features = (tuple(f[:n] for f in features) if multi
+                            else features[:n])
+                if labels is not None:
+                    labels = labels[:n]
+
         mode, batch, num_batches = self._plan(n)
+        if strategy != "default" and batch > n:
+            raise ValueError(
+                f"mini_batch_size rounds to {batch} rows (> dataset {n}); "
+                f"{strategy} fits cannot pad batches — lower miniBatchSize")
         # the padded dataset always covers exactly ceil(n/batch) windows; in
         # stochastic mode num_batches may exceed that (resampled permutations)
         total = -(-n // batch) * batch
@@ -381,6 +557,11 @@ class Trainer:
             x_pad, mask = pad_to_batches(features, batch, total // batch)
         if labels is not None:
             y_pad, _ = pad_to_batches(labels, batch, total // batch)
+        elif task == "lm" and not multi:
+            # unsupervised pp-lm/sp fits consume the label slot as the
+            # attention mask (token loss weights); single-input means no
+            # mask column -> every token weighs 1
+            y_pad = np.ones((total, features.shape[1]), np.float32)
         else:
             y_pad = np.zeros((total, 1), np.float32)  # dummy; loss ignores it
 
@@ -392,7 +573,16 @@ class Trainer:
             params = jax.tree.map(lambda a: jnp.array(a), init_params)
         else:
             params = self.model.init(init_rng)
-        pspecs = self._resolve_pspecs()
+        if strategy == "pp":
+            # repack into the stage-stacked pipeline layout, sharded over
+            # 'pp' (merged back to the standard layout at the end of fit,
+            # so serving/weights export never see pipeline internals)
+            from .parallel.pp import pp_pspecs, split_stage_params
+            params = split_stage_params(self.model, params,
+                                        self.mesh.shape["pp"])
+            pspecs = pp_pspecs(params)
+        else:
+            pspecs = self._resolve_pspecs()
         if pspecs is not None:
             # tp/fsdp: place params per their PartitionSpecs BEFORE the
             # optimizer init so mu/nu/etc inherit the same placement
@@ -438,20 +628,23 @@ class Trainer:
         # remaining epoch as ONE compiled program (lax.scan over the epoch
         # body; single device dispatch for the whole fit). Per-epoch rngs are
         # generated exactly like the loop below, so losses match it.
+        step_fn = (self._make_strategy_step(strategy, task, batch)
+                   if strategy != "default" else None)
         k = total_epochs - start_epoch
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
                 and not self.halt_on_nan):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
-                    pspecs is not None)
+                    pspecs is not None, strategy,
+                    self.pp_schedule, self.pp_microbatches)
             if fkey not in self._epoch_cache:
                 loss_fn = make_loss_fn(self.model, self.input_name,
                                        self.label_name)
                 self._epoch_cache[fkey] = make_multi_epoch_fn(
                     loss_fn, self.optimizer, batch, num_batches, mode,
                     self.shuffle_per_iter, k, self.mesh, n_real=n,
-                    infer_params=pspecs is not None)
+                    infer_params=pspecs is not None, step_fn=step_fn)
             erngs = []
             for _ in range(k):
                 rng, erng = jax.random.split(rng)
@@ -461,6 +654,9 @@ class Trainer:
             params = jax.block_until_ready(params)
             wall = time.perf_counter() - t0
             per_epoch = num_batches * batch if mode == "stochastic" else n
+            if strategy == "pp":
+                from .parallel.pp import merge_stage_params
+                params = merge_stage_params(self.model, params)
             self.params = params
             self._last_opt_state = opt_state
             epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
@@ -469,13 +665,14 @@ class Trainer:
                                per_epoch * k / max(wall, 1e-9), wall)
 
         cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
-                     n if mode == "stochastic" else None, pspecs is not None)
+                     n if mode == "stochastic" else None, pspecs is not None,
+                     strategy, self.pp_schedule, self.pp_microbatches)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
             self._epoch_cache[cache_key] = make_epoch_fn(
                 loss_fn, self.optimizer, batch, num_batches, mode,
                 self.shuffle_per_iter, self.mesh, n_real=n,
-                infer_params=pspecs is not None)
+                infer_params=pspecs is not None, step_fn=step_fn)
         epoch_fn = self._epoch_cache[cache_key]
 
         from .utils.preempt import NullGuard, PreemptionGuard
@@ -589,6 +786,9 @@ class Trainer:
         # count; stochastic mode counts sampled slots (its actual step volume)
         per_epoch = num_batches * batch if mode == "stochastic" else n
         seen = per_epoch * ran
+        if strategy == "pp":
+            from .parallel.pp import merge_stage_params
+            params = merge_stage_params(self.model, params)
         self.params = params
         self._last_opt_state = opt_state
         epoch_keys = sorted(loss_by_it)
@@ -605,7 +805,14 @@ class Trainer:
         if self._last_opt_state is None:
             return None
         from .optimizers import extract_ema_params
-        return extract_ema_params(self._last_opt_state)
+        ema = extract_ema_params(self._last_opt_state)
+        if ema is not None and self.mesh is not None \
+                and self._mesh_strategy() == "pp":
+            # the pp opt state tracks the stage-stacked layout; serve the
+            # standard layout like fit() does for the final weights
+            from .parallel.pp import merge_stage_params
+            ema = merge_stage_params(self.model, ema)
+        return ema
 
     @staticmethod
     def _warn_non_finite(epoch_losses, epoch_numbers=None):
@@ -648,6 +855,11 @@ class Trainer:
         from .localml.linalg import vector_to_array
         from .utils.data import BatchQueue, feed_from_iterator
 
+        if self._mesh_strategy() != "default":
+            raise ValueError(
+                "fit_stream trains dp/tp/fsdp/ep meshes; pp/sp strategy "
+                "fits need the whole dataset staged for their fixed-shape "
+                "batch schedules — use fit() (fitMode='collect')")
         multi = isinstance(self.input_name, (list, tuple))
         factory = row_iterator if callable(row_iterator) else None
         if epochs > 1 and factory is None:

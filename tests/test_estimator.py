@@ -493,8 +493,15 @@ def test_mesh_shape_validation(spark, gaussian_df):
     mg = build_graph(create_model)
     with pytest.raises(ValueError, match="unknown mesh axis"):
         base_estimator(mg, meshShape="dp=2,bogus=4").fit(gaussian_df)
-    with pytest.raises(ValueError, match="not estimator strategies"):
+    # sp/pp are estimator strategies since round 5 — but only for the model
+    # families their step builders pipeline/ring over, NOT nn-DSL graphs
+    with pytest.raises(ValueError, match="TransformerLM"):
         base_estimator(mg, meshShape="dp=2,sp=4").fit(gaussian_df)
+    with pytest.raises(ValueError, match="block structure"):
+        base_estimator(mg, meshShape="dp=2,pp=4").fit(gaussian_df)
+    with pytest.raises(ValueError, match="fitMode"):
+        base_estimator(mg, meshShape="dp=2,pp=4",
+                       fitMode="stream").fit(gaussian_df)
     with pytest.raises(ValueError, match="param_pspecs"):
         # tp on an nn-DSL graph: no megatron rules -> must refuse, not
         # silently replicate (redundant work on every tp rank)
@@ -572,6 +579,76 @@ def test_mesh_shape_ep_moe(spark):
     from sparkflow_tpu.ml_util import convert_json_to_weights
     for a, b in zip(convert_json_to_weights(m_ep.getOrDefault(m_ep.modelWeights)),
                     convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_mesh_shape_pp_matches_default(spark):
+    """pp via meshShape on a registry transformer: estimator-level pipeline
+    parallelism (GPipe over the 'pp' ring composed with dp), update-exact —
+    the pp fit's weights match the default dp fit because the strategy step
+    slots into the SAME shuffle/batching epoch program."""
+    from sparkflow_tpu.models import build_registry_spec
+
+    spec = build_registry_spec("transformer_classifier", vocab_size=30,
+                               num_classes=2, hidden=32, num_layers=2,
+                               num_heads=4, mlp_dim=64, max_len=8, dropout=0.0)
+    rs = np.random.RandomState(7)
+    rows = [(float(rs.randint(0, 2)),
+             Vectors.dense(rs.randint(0, 30, 8).astype(float)))
+            for _ in range(64)]
+    df = spark.createDataFrame(rows, ["label", "features"])
+
+    def est(**kw):
+        return SparkAsyncDL(inputCol="features", tensorflowGraph=spec,
+                            tfInput="input_ids", tfLabel="y", tfOutput="logits",
+                            labelCol="label", tfOptimizer="adam",
+                            tfLearningRate=.01, iters=4, miniBatchSize=16,
+                            predictionCol="predicted", **kw)
+
+    m_pp = est(meshShape="dp=4,pp=2").fit(df)
+    m_dp = est().fit(df)
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    for a, b in zip(convert_json_to_weights(m_pp.getOrDefault(m_pp.modelWeights)),
+                    convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+    # and the fitted model serves
+    assert m_pp.transform(df).count() == 64
+
+
+def test_mesh_shape_sp_lm(spark):
+    """sp via meshShape on a causal LM (ring attention over the sequence):
+    estimator-level sequence parallelism. The estimator fit's weights match
+    a Trainer fit on the same sp mesh/seed — the Param surface adds no
+    drift — and differ from unsharded training only by the documented
+    shard-boundary token exclusion (parallel/sp.py)."""
+    from sparkflow_tpu.models import build_registry_spec
+    from sparkflow_tpu.parallel.mesh import make_mesh
+    from sparkflow_tpu.trainer import Trainer
+
+    spec = build_registry_spec("transformer_lm", vocab_size=30, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=8, dropout=0.0)
+    rs = np.random.RandomState(3)
+    toks = rs.randint(0, 30, (64, 8))
+    rows = [(Vectors.dense(t.astype(float)),) for t in toks]
+    df = spark.createDataFrame(rows, ["features"])
+
+    est = SparkAsyncDL(inputCol="features", tensorflowGraph=spec,
+                       tfInput="input_ids", tfLabel=None, labelCol=None,
+                       tfOutput="logits", tfOptimizer="adam",
+                       tfLearningRate=.01, iters=4, miniBatchSize=16,
+                       predictionCol="predicted", meshShape="dp=2,sp=4")
+    m_sp = est.fit(df)
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    tr = Trainer(spec, "input_ids", None, optimizer="adam",
+                 learning_rate=.01, iters=4, mini_batch_size=16, mesh=mesh)
+    tr.fit(toks.astype(np.float32))
+    from sparkflow_tpu.graphdef import params_to_list
+    from sparkflow_tpu.ml_util import convert_json_to_weights
+    w_est = convert_json_to_weights(m_sp.getOrDefault(m_sp.modelWeights))
+    w_tr = params_to_list(tr.model, tr.params)
+    for a, b in zip(w_est, w_tr):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 

@@ -292,6 +292,108 @@ def test_fit_stream_checkpoints_and_resumes_weights(tmp_path):
     assert np.abs(w_resumed - w_after).max() < 0.1
 
 
+def _tiny_clf_spec():
+    from sparkflow_tpu.models import build_registry_spec
+    return build_registry_spec("transformer_classifier", vocab_size=30,
+                               num_classes=2, hidden=32, num_layers=2,
+                               num_heads=4, mlp_dim=64, max_len=8,
+                               dropout=0.0)
+
+
+def test_trainer_pp_mesh_matches_default():
+    """meshShape-style 'pp' axis on the Trainer: the pipeline fit's weights
+    equal the default fit's (the pp step runs inside the same shuffle/batch
+    epoch program) — for both the gpipe and 1f1b schedules."""
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    spec = _tiny_clf_spec()
+    rs = np.random.RandomState(7)
+    ids = rs.randint(0, 30, (64, 8)).astype(np.float32)
+    lbl = rs.randint(0, 2, 64).astype(np.float32)
+
+    def fit(mesh=None, **kw):
+        tr = Trainer(spec, "input_ids", "y", optimizer="adam",
+                     learning_rate=.01, iters=3, mini_batch_size=16,
+                     mesh=mesh, **kw)
+        return tr, tr.fit(ids, lbl)
+
+    t_def, r_def = fit()
+    mesh = make_mesh({"dp": 4, "pp": 2})
+    for sched in ("gpipe", "1f1b"):
+        t_pp, r_pp = fit(mesh=mesh, pp_schedule=sched, pp_microbatches=2)
+        np.testing.assert_allclose(r_pp.losses, r_def.losses, atol=5e-4)
+        for k in t_def.params:
+            a = np.concatenate([np.ravel(x) for x in
+                                jax.tree.leaves(t_def.params[k])])
+            b = np.concatenate([np.ravel(x) for x in
+                                jax.tree.leaves(t_pp.params[k])])
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_trainer_strategy_validation():
+    """pp/sp mesh-axis combos and model families fail fast with actionable
+    errors; fit_stream refuses strategy meshes."""
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    spec = _tiny_clf_spec()
+    with pytest.raises(ValueError, match="pick one strategy"):
+        Trainer(spec, "input_ids", "y",
+                mesh=make_mesh({"dp": 2, "pp": 2, "sp": 2}))._mesh_strategy()
+    with pytest.raises(ValueError, match="composes with 'dp' only"):
+        Trainer(spec, "input_ids", "y",
+                mesh=make_mesh({"tp": 4, "pp": 2}))._mesh_strategy()
+    # nn-DSL graph on a pp mesh: no block structure -> actionable refusal
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0",
+                 mesh=make_mesh({"dp": 4, "pp": 2}), mini_batch_size=16)
+    with pytest.raises(ValueError, match="block structure"):
+        tr.fit(np.random.rand(32, 10).astype(np.float32),
+               np.eye(2)[np.random.randint(0, 2, 32)])
+    # supervised label on an sp mesh: sp is next-token training
+    tr2 = Trainer(spec, "input_ids", "y",
+                  mesh=make_mesh({"dp": 2, "sp": 4}), mini_batch_size=16)
+    with pytest.raises(ValueError, match="TransformerLM"):
+        tr2.fit(np.zeros((32, 8), np.float32), np.zeros(32, np.float32))
+    # fit_stream refuses strategy meshes outright
+    tr3 = Trainer(spec, "input_ids", None,
+                  mesh=make_mesh({"dp": 4, "pp": 2}), mini_batch_size=16)
+    with pytest.raises(ValueError, match="fit_stream"):
+        tr3.fit_stream(iter([]))
+    # pp classifier has no attention-mask path: multi-input refuses loudly
+    # instead of silently dropping the mask column
+    tr4 = Trainer(spec, ["input_ids", "attention_mask"], "y",
+                  mesh=make_mesh({"dp": 4, "pp": 2}), mini_batch_size=16)
+    with pytest.raises(ValueError, match="attention-mask"):
+        tr4.fit((np.zeros((32, 8), np.float32),
+                 np.ones((32, 8), np.float32)),
+                np.zeros(32, np.float32))
+    # explicit param_sharding pytrees cannot apply to strategy meshes
+    tr5 = Trainer(spec, "input_ids", "y",
+                  mesh=make_mesh({"dp": 4, "pp": 2}), mini_batch_size=16,
+                  param_sharding={})
+    with pytest.raises(ValueError, match="param_sharding"):
+        tr5.fit(np.zeros((32, 8), np.float32), np.zeros(32, np.float32))
+
+
+def test_trainer_pp_remainder_rows_trimmed(caplog):
+    """Non-dividing dataset sizes on a strategy mesh: the remainder is
+    dropped with a warning (pp steps carry no padded-row masking), and the
+    fit still completes."""
+    import logging
+
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    spec = _tiny_clf_spec()
+    rs = np.random.RandomState(1)
+    ids = rs.randint(0, 30, (70, 8)).astype(np.float32)  # 70 % 16 != 0
+    lbl = rs.randint(0, 2, 70).astype(np.float32)
+    tr = Trainer(spec, "input_ids", "y", optimizer="adam", iters=2,
+                 mini_batch_size=16, mesh=make_mesh({"dp": 4, "pp": 2}))
+    with caplog.at_level(logging.WARNING, logger="sparkflow_tpu"):
+        r = tr.fit(ids, lbl)
+    assert any("remainder" in m for m in caplog.messages)
+    assert all(np.isfinite(l) for l in r.losses)
+
+
 def test_resume_from_pre_schema_checkpoint(tmp_path):
     """Back-compat: checkpoints written before the rng_impl leaf was added
     (schema without it) still restore — the template-retry in _ckpt_restore
