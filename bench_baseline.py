@@ -1,14 +1,31 @@
 """Measure the reference-equivalent baseline: single-node Hogwild-style CNN
 training throughput on CPU.
 
-The reference (TF 1.10 + Spark 2.4.3) is not installable in this image, so the
-baseline is a faithful CPU proxy of its training loop using torch (CPU): the same
-MNIST CNN, mini-batch SGD-with-adam steps, plus the reference's per-batch
-parameter-server exchange cost — every batch serializes the full gradient list
-and deserializes the full weight list with pickle, exactly the wire work
-``GET /parameters`` / ``POST /update`` did (``sparkflow/HogwildSparkModel.py:
-22-35,57-58,75-76``; loopback HTTP latency excluded, which only favors the
-baseline). Writes BASELINE_MEASURED.json; run once, committed.
+The reference (TF 1.10 + Spark 2.4.3) is not installable in this image; two
+CPU proxies of its training loop are measured and committed:
+
+1. **TF1-session proxy** (primary — ``measure_tf1``): live ``tf.compat.v1``
+   graph + Session, reproducing the reference's ACTUAL cost profile
+   (``sparkflow/HogwildSparkModel.py:38-100``, ``ml_util.py:9-28``):
+
+   - worker: full-weight pickle round-trip (the ``GET /parameters`` wire
+     work), ``tensorflow_set_weights``-style weight install — fresh
+     placeholders + assign ops built on EVERY call (the reference grows its
+     graph per batch) — then ONE ``sess.run`` PER TRAINABLE VARIABLE for
+     the gradients (``grads[x][0].eval`` in a Python loop: each run re-executes
+     the forward), then a full-gradient pickle round-trip (``POST /update``).
+   - server: ``apply_gradients`` train_op run with the fed gradients + a
+     ``tensorflow_get_weights`` fetch of every variable, per batch
+     (``HogwildSparkModel.py:219-240``).
+   - loopback HTTP latency excluded, which only favors the baseline.
+
+2. **torch proxy** (kept for continuity with rounds 1-4 — ``measure``):
+   same CNN/optimizer/batch with a SINGLE fused backward per batch + the
+   pickle wire work. It UNDERSTATES the reference's per-variable-run cost,
+   so it is the conservative number.
+
+``vs_baseline`` in bench.py uses the committed torch number (conservative);
+the TF number documents the realistic gap. Run once, committed.
 """
 
 import json
@@ -66,15 +83,156 @@ def measure(batch_size=300, n_batches=12):
     return batch_size * n_batches / wall
 
 
+def measure_tf1(batch_size=300, n_batches=12):
+    """The reference's real per-batch work on live TF1 sessions (see module
+    docstring). Worker and server sessions share the process; the wire work
+    between them is the pickle both sides paid."""
+    import os
+
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    os.environ.setdefault("OMP_NUM_THREADS", "1")
+    import tensorflow as tf
+
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+
+    def build(g):
+        """The cnn_example model in raw TF1 ops (tf1.layers is gone under
+        Keras 3; explicit get_variable + nn ops build the same network)."""
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 784], name="x")
+            y = tf1.placeholder(tf.float32, [None, 10], name="y")
+            xr = tf.reshape(x, [-1, 28, 28, 1])
+            init = tf1.glorot_uniform_initializer(seed=0)
+
+            def conv(inp, cin, cout, k, name):
+                w = tf1.get_variable(f"{name}_w", [k, k, cin, cout],
+                                     initializer=init)
+                b = tf1.get_variable(f"{name}_b", [cout],
+                                     initializer=tf1.zeros_initializer())
+                c = tf.nn.relu(tf.nn.bias_add(
+                    tf1.nn.conv2d(inp, w, [1, 1, 1, 1], "VALID"), b))
+                return tf1.nn.max_pool(c, [1, 2, 2, 1], [1, 2, 2, 1], "VALID")
+
+            h = conv(xr, 1, 32, 5, "c1")
+            h = conv(h, 32, 64, 3, "c2")
+            flat = tf.reshape(h, [-1, 64 * 5 * 5])
+            wd = tf1.get_variable("fc_w", [64 * 5 * 5, 10], initializer=init)
+            bd = tf1.get_variable("fc_b", [10],
+                                  initializer=tf1.zeros_initializer())
+            logits = tf1.nn.xw_plus_b(flat, wd, bd)
+            loss = tf.reduce_mean(
+                tf.nn.softmax_cross_entropy_with_logits(
+                    labels=tf.stop_gradient(y), logits=logits))
+            return x, y, loss
+
+    rs = np.random.RandomState(0)
+    xb = rs.rand(batch_size, 784).astype(np.float32)
+    yb = np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch_size)]
+
+    # worker graph/session: per-variable gradient fetches
+    wg = tf1.Graph()
+    x, y, loss = build(wg)
+    with wg.as_default():
+        wvars = tf1.trainable_variables()
+        wgrads = tf1.gradients(loss, wvars)
+        winit = tf1.global_variables_initializer()
+    wsess = tf1.Session(graph=wg,
+                        config=tf1.ConfigProto(intra_op_parallelism_threads=1,
+                                               inter_op_parallelism_threads=1))
+    wsess.run(winit)
+
+    # server graph/session: apply_gradients on FED gradient values
+    sg = tf1.Graph()
+    _, _, sloss = build(sg)
+    with sg.as_default():
+        svars = tf1.trainable_variables()
+        sgrads = tf1.gradients(sloss, svars)
+        train_op = tf1.train.AdamOptimizer(1e-4).apply_gradients(
+            list(zip(sgrads, svars)))
+        sinit = tf1.global_variables_initializer()
+    ssess = tf1.Session(graph=sg,
+                        config=tf1.ConfigProto(intra_op_parallelism_threads=1,
+                                               inter_op_parallelism_threads=1))
+    ssess.run(sinit)
+    weights = ssess.run(svars)  # tensorflow_get_weights
+
+    def set_weights(values):
+        # tensorflow_set_weights (ml_util.py:16-28): NEW placeholders and
+        # assign ops every call — the graph grows per batch, as shipped
+        with wg.as_default():
+            ops, feed = [], {}
+            for var, value in zip(wvars, values):
+                ph = tf1.placeholder(var.dtype, shape=value.shape)
+                ops.append(var.assign(ph))
+                feed[ph] = value
+            wsess.run(ops, feed_dict=feed)
+
+    # warmup (compile kernels both sides)
+    set_weights(weights)
+    _ = [wsess.run(g, {x: xb, y: yb}) for g in wgrads]
+    ssess.run(train_op, dict(zip(sgrads, _)))
+
+    t0 = time.perf_counter()
+    for _i in range(n_batches):
+        served = pickle.loads(pickle.dumps(weights, -1))  # GET /parameters
+        set_weights(served)
+        gradients = []
+        for g in wgrads:  # one sess.run PER VARIABLE (grads[x][0].eval)
+            gradients.append(wsess.run(g, {x: xb, y: yb}))
+        sent = pickle.loads(pickle.dumps(gradients, -1))  # POST /update
+        ssess.run(train_op, feed_dict=dict(zip(sgrads, sent)))
+        weights = ssess.run(svars)  # tensorflow_get_weights, per update
+    wall = time.perf_counter() - t0
+    return batch_size * n_batches / wall
+
+
 if __name__ == "__main__":
-    eps = measure()
+    import os
+
+    eps = round(measure(), 1)
+    try:
+        tf_eps = round(measure_tf1(), 1)
+        tf_how = ("tf.compat.v1 Session proxy of the reference loop: fresh "
+                  "assign ops per weight install, ONE sess.run per variable "
+                  "for gradients, adam apply_gradients + full weight fetch "
+                  "on the server side, pickle wire both ways (batch 300, "
+                  "single-thread, loopback HTTP excluded)")
+    except Exception as e:  # TF missing/broken: keep the torch number
+        tf_eps, tf_how = None, f"tf1 proxy unavailable: {e}"
+
+    # BEST-OF-RUNS, favoring the baseline: merge with the committed file so
+    # a re-run on a loaded machine can only RAISE the denominator bench.py
+    # divides by (reported speedups stay a floor), never lower it
+    path = "BASELINE_MEASURED.json"
+    prev = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            prev = json.load(f)
+    best = max(eps, prev.get("baseline_examples_per_sec") or 0)
+    best_tf = max(tf_eps or 0,
+                  prev.get("baseline_tf1_examples_per_sec") or 0) or None
+    if tf_eps is None and prev.get("baseline_tf1_examples_per_sec"):
+        # this run could not measure TF1 but a committed number exists:
+        # carry its provenance forward, don't relabel it with the error
+        tf_how = prev.get("how_tf1", tf_how)
     out = {
         "metric": "mnist_cnn_examples_per_sec",
-        "baseline_examples_per_sec": round(eps, 1),
+        "baseline_examples_per_sec": best,
         "how": "torch-CPU single-thread proxy of the reference Hogwild loop "
                "(same CNN, adam, batch 300, full pickle weight+grad round-trip "
-               "per batch; loopback HTTP latency excluded)",
+               "per batch; loopback HTTP latency excluded). CONSERVATIVE: one "
+               "fused backward per batch vs the reference's per-variable "
+               "sess.runs — see baseline_tf1_examples_per_sec for the "
+               "faithful TF-session number. Best-of-runs kept across "
+               f"re-measurements (this run: {eps})",
+        "baseline_tf1_examples_per_sec": best_tf,
+        "how_tf1": tf_how + (f". Best-of-runs kept (this run: {tf_eps})"
+                             if tf_eps else ""),
+        "policy": "vs_baseline divides by baseline_examples_per_sec (torch, "
+                  "best-of-runs) — the highest defensible reference-"
+                  "equivalent number, so the reported speedup is a floor",
     }
-    with open("BASELINE_MEASURED.json", "w") as f:
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -549,6 +549,15 @@ class Trainer:
         # the padded dataset always covers exactly ceil(n/batch) windows; in
         # stochastic mode num_batches may exceed that (resampled permutations)
         total = -(-n // batch) * batch
+        # strategy steps have NO padded-row masking: sweep/full epochs must
+        # be pad-free after the trim (stochastic mode is exempt — its
+        # batches sample indices from the n REAL rows only, so the padded
+        # tail is never read). Guards the trim-unit/_plan rounding coupling:
+        # if they ever diverge, fail here instead of training on padding.
+        if strategy != "default" and mode != "stochastic" and total != n:
+            raise RuntimeError(
+                f"{strategy} fit planned {total} padded rows over {n} real "
+                f"ones — internal trim/_plan divergence, please report")
         if multi:
             padded = [pad_to_batches(f, batch, total // batch)
                       for f in features]
