@@ -137,8 +137,16 @@ def bench_bert_step(compute_dtype):
                                            (B, cfg["max_len"])), jnp.int32),
                     jnp.asarray(np.eye(2)[rs.randint(0, 2, B)], jnp.float32))
 
+        def key(i):
+            # hardware PRNG dropout keys on TPU: threefry mask generation is
+            # pure VPU overhead on the step (the mfu_sweep 'rbg' variant
+            # measures the delta); the headline entry runs the best config
+            if jax.default_backend() == "tpu":
+                return jax.random.key(i, impl="rbg")
+            return jax.random.PRNGKey(i)
+
         ids, y = batch(0)
-        params, state, loss = step(params, state, ids, y, jax.random.PRNGKey(0))
+        params, state, loss = step(params, state, ids, y, key(0))
         jax.block_until_ready(params)
         if jax.default_backend() == "tpu":
             # fail LOUDLY if the perf path degraded: a kernel edit that broke
@@ -153,8 +161,7 @@ def bench_bert_step(compute_dtype):
         n_steps = 3 if QUICK else 8
         for i in range(n_steps):
             ids, y = batch(i + 1)
-            params, state, loss = step(params, state, ids, y,
-                                       jax.random.PRNGKey(i))
+            params, state, loss = step(params, state, ids, y, key(i))
         jax.block_until_ready(params)
         return (time.perf_counter() - t0) / n_steps
 

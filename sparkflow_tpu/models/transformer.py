@@ -163,7 +163,11 @@ class _TransformerBase(RegistryModel):
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = self._proj(bp, "qkv_", y)
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
-        q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
+        # ONE relayout for all three tensors ([B,S,3,h,d] -> [3,B,h,S,d]),
+        # not three sliced transposes — TPU relayouts are real copies and
+        # this is on the per-block hot path (same math, layout only)
+        qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
+        q, k, v = qkv[0], qkv[1], qkv[2]
         att = self._attention(q, k, v, mask, causal)
         att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
         att, rng = self._dropout(self._proj(bp, "o_", att), train, rng)
