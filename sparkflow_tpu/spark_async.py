@@ -245,6 +245,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # upgrade: the fitted model stores the Polyak-averaged weights instead of
     # the raw final ones; requires {'ema_decay': d} in optimizerOptions
     useEmaWeights = Param(Params._dummy(), "useEmaWeights", "", typeConverter=TypeConverters.toBoolean)
+    # upgrades: pipeline-parallel knobs for meshShape='...,pp=N' fits —
+    # microbatches per batch (-1 = deepest power of two the per-replica
+    # batch divides) and schedule ('gpipe' | '1f1b' | 'sequential')
+    ppMicrobatches = Param(Params._dummy(), "ppMicrobatches", "", typeConverter=TypeConverters.toInt)
+    ppSchedule = Param(Params._dummy(), "ppSchedule", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -276,7 +281,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  extraInputCols=None,
                  extraTfInputs=None,
                  meshShape=None,
-                 useEmaWeights=None):
+                 useEmaWeights=None,
+                 ppMicrobatches=None,
+                 ppSchedule=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -294,7 +301,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          weightsPath=None, checkpointDir=None, checkpointEvery=0,
                          fitMode='collect', extraInputCols=None,
                          extraTfInputs=None, meshShape=None,
-                         useEmaWeights=False)
+                         useEmaWeights=False, ppMicrobatches=-1,
+                         ppSchedule='gpipe')
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -329,7 +337,9 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   extraInputCols=None,
                   extraTfInputs=None,
                   meshShape=None,
-                 useEmaWeights=None):
+                 useEmaWeights=None,
+                 ppMicrobatches=None,
+                 ppSchedule=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -445,6 +455,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 # axis makes e.g. "fsdp=8" mean "all devices shard params,
                 # none shard data" instead of a deep GSPMD error
                 mesh_axes = {"dp": 1, **mesh_axes}
+        sched = _opt_param(self, self.ppSchedule, "gpipe") or "gpipe"
+        if sched not in ("gpipe", "1f1b", "sequential"):
+            raise ValueError(
+                "ppSchedule must be 'gpipe', '1f1b', or 'sequential'; got %r"
+                % sched)
         if self.getOrDefault(self.useEmaWeights):
             # fail BEFORE training, not after hours of fit: the EMA only
             # exists when the optimizer maintains it (build_optimizer
@@ -515,6 +530,10 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             mesh=(make_mesh(mesh_axes) if mesh_axes else default_mesh()),
             checkpoint_dir=self.getOrDefault(self.checkpointDir),
             checkpoint_every=self.getOrDefault(self.checkpointEvery) or 0,
+            pp_microbatches=(None if (_opt_param(self, self.ppMicrobatches,
+                                                 -1) or -1) < 1
+                             else _opt_param(self, self.ppMicrobatches)),
+            pp_schedule=_opt_param(self, self.ppSchedule, "gpipe") or "gpipe",
         )
         if fit_mode == "stream":
             # one epoch = one pass over rdd.toLocalIterator(): the dataset

@@ -489,6 +489,15 @@ def test_mesh_shape_fsdp_matches_default(spark, gaussian_df):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+def test_mesh_shape_dp_less_injects_dp(spark, gaussian_df):
+    """A dp-less meshShape ('fsdp=8') gets a size-1 dp axis injected so the
+    epoch program can shard dataset rows — the fit trains instead of dying
+    in GSPMD (regression: a misindent once made the injection dead code)."""
+    mg = build_graph(create_model)
+    model = base_estimator(mg, iters=10, meshShape="fsdp=8").fit(gaussian_df)
+    assert calculate_errors(model.transform(gaussian_df)) < 400
+
+
 def test_mesh_shape_validation(spark, gaussian_df):
     mg = build_graph(create_model)
     with pytest.raises(ValueError, match="unknown mesh axis"):
@@ -613,6 +622,17 @@ def test_mesh_shape_pp_matches_default(spark):
         np.testing.assert_allclose(a, b, atol=5e-4)
     # and the fitted model serves
     assert m_pp.transform(df).count() == 64
+
+    # the pp knobs are Params too: the 1f1b schedule with explicit
+    # microbatching stays update-exact
+    m_1f1b = est(meshShape="dp=4,pp=2", ppSchedule="1f1b",
+                 ppMicrobatches=2).fit(df)
+    for a, b in zip(
+            convert_json_to_weights(m_1f1b.getOrDefault(m_1f1b.modelWeights)),
+            convert_json_to_weights(m_dp.getOrDefault(m_dp.modelWeights))):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+    with pytest.raises(ValueError, match="ppSchedule"):
+        est(meshShape="dp=4,pp=2", ppSchedule="zigzag").fit(df)
 
 
 def test_mesh_shape_sp_lm(spark):
