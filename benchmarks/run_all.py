@@ -344,7 +344,7 @@ def bench_ring_flash_long_context():
     efficiency, not just scale. TPU-only; amortized over fresh inputs."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from sparkflow_tpu.jax_compat import shard_map
     from jax.sharding import Mesh
     from jax.sharding import PartitionSpec as P
 
@@ -578,6 +578,76 @@ def bench_dataplane():
               {"skipped": "no C++ toolchain"})
 
 
+def bench_dp_zero1():
+    """ZeRO-1 weight-update sharding vs the replicated dp step: step time and
+    per-device optimizer-state bytes (expect ~1/dp) on a pure-dp mesh over
+    all local devices. One JSON line; skips below 2 devices."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.models import build_registry_spec, model_from_json
+    from sparkflow_tpu.optimizers import build_optimizer
+    from sparkflow_tpu.optimizers_sharded import (place_zero1_state,
+                                                  sharded_update,
+                                                  state_bytes_per_device)
+    from sparkflow_tpu.parallel.dp import (make_dp_shardmap_train_step,
+                                           make_dp_zero1_train_step)
+    from sparkflow_tpu.parallel.mesh import make_mesh
+
+    dp = jax.device_count()
+    if dp < 2:
+        _emit("dp_zero1_vs_replicated", 0, "ratio",
+              {"skipped": "needs >= 2 devices"})
+        return
+    hidden = 128 if QUICK else 512
+    layers = 2 if QUICK else 4
+    spec = build_registry_spec("transformer_classifier", vocab_size=1000,
+                               num_classes=8, hidden=hidden,
+                               num_layers=layers, num_heads=8,
+                               mlp_dim=4 * hidden, max_len=64, dropout=0.0)
+    m = model_from_json(spec)
+    opt = build_optimizer("adam", 1e-3, None)
+    mesh = make_mesh({"dp": dp})
+    B = 8 * dp
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 1000, (B, 64)), jnp.float32)
+    y = jnp.asarray(np.eye(8, dtype=np.float32)[rs.randint(0, 8, B)])
+    mask = jnp.ones((B,), jnp.float32)
+    p0 = m.init(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    steps = 5 if QUICK else 20
+
+    def timed(step, params, state):
+        params, state, _ = step(params, state, ids, y, mask, rng)  # compile
+        jax.block_until_ready(params)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, state, _ = step(params, state, ids, y, mask, rng)
+        jax.block_until_ready(params)
+        return (time.perf_counter() - t0) / steps, state
+
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    pR = jax.device_put(jax.tree.map(jnp.array, p0), repl)
+    sR = jax.device_put(opt.init(pR), repl)
+    tR, sR = timed(make_dp_shardmap_train_step(m, opt, mesh, "input_ids", "y"),
+                   pR, sR)
+    bytesR = state_bytes_per_device(sR)
+
+    pZ = jax.device_put(jax.tree.map(jnp.array, p0), repl)
+    sZ = place_zero1_state(sharded_update(opt, dp, "dp").init(pZ), mesh, dp)
+    tZ, sZ = timed(make_dp_zero1_train_step(m, opt, mesh, "input_ids", "y"),
+                   pZ, sZ)
+    bytesZ = state_bytes_per_device(sZ)
+
+    _emit("dp_zero1_vs_replicated", tR / tZ, "step_time_speedup_x",
+          {"dp": dp,
+           "replicated_step_ms": round(tR * 1e3, 2),
+           "zero1_step_ms": round(tZ * 1e3, 2),
+           "replicated_opt_state_bytes_per_device": int(bytesR),
+           "zero1_opt_state_bytes_per_device": int(bytesZ),
+           "opt_state_reduction_x": round(bytesR / max(bytesZ, 1), 2)})
+
+
 def main():
     import os
     import sys as _sys
@@ -600,6 +670,7 @@ def main():
     bench_flash_long_context()
     bench_ring_flash_long_context()
     bench_stream_vs_collect(compute_dtype)
+    bench_dp_zero1()
     bench_quantized_inference()
     bench_tokenizer()
     bench_dataplane()

@@ -5,6 +5,14 @@ optimizer state dies with the parameter-server process (SURVEY.md §5
 "Checkpoint/resume"). This module is the capability upgrade: periodic
 checkpoints of (params, opt_state, step, rng) during training, resumable
 mid-run, plus a plain-weights export for the model loader.
+
+Sharded opt-state interop: zero1 (weight-update-sharded) fits checkpoint the
+STANDARD param-shaped opt state, not the flat sharded layout — the trainer
+converts via ``optimizers_sharded.gather_zero1_state`` before ``save`` and
+re-shards (re-padding for the restoring mesh's dp size) after ``restore``.
+Checkpoint directories are therefore interchangeable between zero1-on/off
+runs and across mesh-shape changes; ``save``'s ``np.asarray`` pass also
+transparently gathers any still-device-sharded leaves it is handed.
 """
 
 from __future__ import annotations

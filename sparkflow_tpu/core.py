@@ -144,7 +144,7 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
     step = _sharded_trace_guard(step, mesh)
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("dp"))
+    data = NamedSharding(mesh, _rows_spec(mesh))
     pspec = None if infer_params else repl
     return jax.jit(
         step,
@@ -154,24 +154,36 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     )
 
 
+def _rows_spec(mesh: Mesh) -> P:
+    """Batch-row PartitionSpec for ``mesh``: over 'dp' when the mesh has one,
+    replicated otherwise — a strategy mesh like ``make_mesh({'pp': 2})`` has
+    no dp axis, and pinning P('dp') there dies inside jax with an opaque
+    unknown-axis error."""
+    return P("dp") if "dp" in mesh.axis_names else P()
+
+
 def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh],
-                    infer_params: bool = False) -> Callable:
+                    infer_params: bool = False,
+                    opt_shardings=None) -> Callable:
     """Shared jit wrapper for epoch-shaped programs
     ``fn(params, opt_state, data, labels, mask, rng)``. ``infer_params=True``
     leaves param/opt-state shardings to be inferred from the argument arrays
     (sharded-parameter training: tp/fsdp); the default pins them replicated
-    (pure dp)."""
+    (pure dp). ``opt_shardings`` overrides just the opt-state in/out sharding
+    with a matching NamedSharding pytree — the zero1 path, where the state
+    shards over dp while params stay replicated."""
     if mesh is None:
         return jax.jit(fn, donate_argnums=(0, 1))
     fn = _sharded_trace_guard(fn, mesh)
     repl = NamedSharding(mesh, P())
-    rows = NamedSharding(mesh, P("dp"))  # dataset rows sharded over dp; XLA
+    rows = NamedSharding(mesh, _rows_spec(mesh))  # dataset rows over dp; XLA
     # re-shards each scanned batch and all-reduces gradients over ICI
     pspec = None if infer_params else repl
+    ospec = opt_shardings if opt_shardings is not None else pspec
     return jax.jit(
         fn,
-        in_shardings=(pspec, pspec, rows, rows, rows, repl),
-        out_shardings=(pspec, pspec, repl),
+        in_shardings=(pspec, ospec, rows, rows, rows, repl),
+        out_shardings=(pspec, ospec, repl),
         donate_argnums=(0, 1),
     )
 
@@ -182,7 +194,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   n_real: Optional[int] = None, _raw: bool = False,
                   infer_params: bool = False,
                   _unroll_budget: Optional[int] = None,
-                  step_fn: Optional[Callable] = None) -> Callable:
+                  step_fn: Optional[Callable] = None,
+                  opt_shardings=None) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -268,7 +281,7 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
     if _raw:
         return epoch
-    return _jit_epoch_like(epoch, mesh, infer_params)
+    return _jit_epoch_like(epoch, mesh, infer_params, opt_shardings)
 
 
 # XLA:CPU runs large ops (convolutions especially) inside while loops ~30x
@@ -293,7 +306,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
                         mesh: Optional[Mesh] = None,
                         n_real: Optional[int] = None,
                         infer_params: bool = False,
-                        step_fn: Optional[Callable] = None) -> Callable:
+                        step_fn: Optional[Callable] = None,
+                        opt_shardings=None) -> Callable:
     """``n_epochs`` whole epochs as ONE compiled program (``lax.scan`` over
     the epoch body): a full ``fit`` becomes a single device dispatch.
 
@@ -328,7 +342,7 @@ def make_multi_epoch_fn(loss_fn: Callable,
             unroll=_cpu_unroll(n_epochs * num_batches))
         return params, opt_state, losses
 
-    return _jit_epoch_like(run, mesh, infer_params)
+    return _jit_epoch_like(run, mesh, infer_params, opt_shardings)
 
 
 def pad_to_batches(x: np.ndarray, batch_size: int,
@@ -374,10 +388,10 @@ def make_predict_fn(model: GraphModel, input_name, output_name: str,
         return jax.jit(predict)
     predict = _sharded_trace_guard(predict, mesh)
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("dp"))
+    data = NamedSharding(mesh, _rows_spec(mesh))
     pspec = None if infer_params else repl
     inner = jax.jit(predict, in_shardings=(pspec, data), out_shardings=data)
-    dp = mesh.shape["dp"]
+    dp = mesh.shape.get("dp", 1)
 
     def padded_predict(params, x):
         # shard divisibility is handled HERE, not by callers: any batch size

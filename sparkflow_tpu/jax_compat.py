@@ -1,0 +1,42 @@
+"""Version shims for jax API drift.
+
+The repo targets the current ``jax.shard_map(..., check_vma=...)`` API; on
+older jax (< 0.6) that symbol lives at ``jax.experimental.shard_map.shard_map``
+and the replication-check kwarg is named ``check_rep``. This module exports a
+``shard_map`` that accepts the NEW spelling everywhere and translates for old
+installs, so callers (library, tests, benchmarks) import from here and stay
+version-agnostic.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)
+
+
+try:  # jax >= 0.6
+    from jax.lax import axis_size
+except ImportError:
+    import jax.core as _core
+
+    def axis_size(axis_name):
+        """Static size of a bound mesh axis (old-jax spelling: the axis
+        frame carries it as a plain int)."""
+        return _core.axis_frame(axis_name)
+
+
+__all__ = ["shard_map", "axis_size"]

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..jax_compat import axis_size
+
 try:  # pallas TPU backend (absent in some CPU-only builds)
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -114,7 +116,7 @@ def _try_shardmap_flash(q, k, v, kv_mask, causal, scale, interpret,
     b, h = q.shape[0], q.shape[1]
     if bsz * hsz <= 1 or b % bsz or h % hsz:
         return None
-    from jax import shard_map
+    from ..jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     bspec = ba if bsz > 1 else None
@@ -713,7 +715,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     q_offset = idx * s_local
@@ -795,7 +797,7 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
 def _ring_flash_forward(q, k, v, kv_mask, axis_name, causal, scale, bq, bk,
                         interpret, with_lse=False):
     b, h, sl, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     have_mask = kv_mask is not None
@@ -855,7 +857,7 @@ def _ring_flash_backward(q, k, v, kv_mask, out, lse, g, axis_name, causal,
     Same three-case causal structure as the forward (strictly-ahead sources
     contribute zero and skip the kernels entirely)."""
     b, h, sl, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     have_mask = kv_mask is not None

@@ -24,6 +24,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..jax_compat import axis_size
+
 
 def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
                        experts_b2, axis_name: str, num_experts: int,
@@ -54,7 +56,7 @@ def all_to_all_moe_ffn(x, router_w, experts_fc1, experts_b1, experts_fc2,
     normalizes the gradient.
     """
     try:
-        n = jax.lax.axis_size(axis_name)
+        n = axis_size(axis_name)
     except NameError as e:
         raise NameError(
             f"mesh axis {axis_name!r} is not bound: an ep_axis MoE model "

@@ -474,3 +474,16 @@ def build_optimizer_from_json(optimizer_name: str, learning_rate: Optional[float
                               optimizer_options_json: Optional[str]) -> optax.GradientTransformation:
     opts = json.loads(optimizer_options_json) if optimizer_options_json else None
     return build_optimizer(optimizer_name, learning_rate, opts)
+
+
+# ZeRO-1 weight-update sharding lives in its own module to keep this one a
+# pure registry; re-exported here so "wrap any registry optimizer" reads as
+# one import site (see optimizers_sharded for layout + checkpoint interop).
+from .optimizers_sharded import (  # noqa: E402
+    sharded_update,
+    zero1_state_specs,
+    place_zero1_state,
+    gather_zero1_state,
+    shard_zero1_state,
+    has_per_param_state,
+)

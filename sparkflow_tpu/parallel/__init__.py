@@ -19,10 +19,11 @@ no parameter server process.
 
 from .mesh import default_mesh, make_mesh, mesh_axis_size
 from . import collectives
-from .dp import make_dp_shardmap_train_step
+from .dp import make_dp_shardmap_train_step, make_dp_zero1_train_step
 from .ep import make_moe_shardmap_train_step, place_moe_params
 from .hyper import HyperResult, hyperparameter_search
 
 __all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives",
-           "make_dp_shardmap_train_step", "make_moe_shardmap_train_step",
+           "make_dp_shardmap_train_step", "make_dp_zero1_train_step",
+           "make_moe_shardmap_train_step",
            "place_moe_params", "HyperResult", "hyperparameter_search"]

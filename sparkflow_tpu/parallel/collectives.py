@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..jax_compat import axis_size
+
 
 def psum_mean(tree, axis_name: str):
     """All-reduce-mean a pytree over a mesh axis (gradient averaging)."""
@@ -38,7 +40,7 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
 def ppermute_ring(x, axis_name: str, shift: int = 1):
     """Rotate shards around the mesh-axis ring (building block of ring
     attention and pipeline schedules)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
@@ -49,8 +51,10 @@ def hierarchical_psum_mean(tree, ici_axis: str, dcn_axis: str):
     axis, all-reduce the 1/n_ici-sized shard over the slow cross-slice DCN
     axis, then ``all_gather`` back over ICI.
 
-    Numerically identical to a flat ``psum`` over both axes divided by the
-    total device count — the point is the WIRE layout: the DCN hop (tens of
+    Mathematically equivalent to a flat ``psum`` over both axes divided by
+    the total device count; bitwise differences are possible because the
+    reduction order changes, and stay bounded by the pinned tolerance in the
+    parity tests. The point is the WIRE layout: the DCN hop (tens of
     GB/s across slices, vs ~100s of GB/s ICI within one) carries only
     ``1/n_ici`` of the gradient bytes, instead of the full tree a flat
     cross-axis psum would move. This is the standard pod-scale data-parallel
@@ -61,8 +65,8 @@ def hierarchical_psum_mean(tree, ici_axis: str, dcn_axis: str):
     does not divide ``n_ici`` are flat-padded for the scatter and unpadded
     after the gather (exactness unaffected: padding reduces to zeros).
     """
-    n_ici = jax.lax.axis_size(ici_axis)
-    total = n_ici * jax.lax.axis_size(dcn_axis)
+    n_ici = axis_size(ici_axis)
+    total = n_ici * axis_size(dcn_axis)
 
     def leaf(x):
         flat = jnp.ravel(x)

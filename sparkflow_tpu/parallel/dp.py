@@ -22,8 +22,26 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from ..jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _check_dcn_axis(mesh: Mesh, dp_axis: str, dcn_axis: Optional[str]):
+    if dcn_axis is None:
+        return
+    if dcn_axis not in mesh.axis_names:
+        # silently downgrading a typo'd axis would replicate the batch over
+        # the real dcn axis (redundant identical updates per slice)
+        raise ValueError(
+            f"dcn_axis={dcn_axis!r} is not a mesh axis "
+            f"{list(mesh.axis_names)}")
+    if dcn_axis == dp_axis:
+        # without this, axes=('dp','dp') fails deep inside psum/shard_map
+        # with an opaque duplicate-axis error
+        raise ValueError(
+            f"dcn_axis={dcn_axis!r} must name a DIFFERENT mesh axis than "
+            f"dp_axis={dp_axis!r}: the two-level reduction needs a distinct "
+            f"slow (cross-slice) axis next to the fast ICI one")
 
 
 def make_dp_shardmap_train_step(model, optimizer, mesh: Mesh,
@@ -42,18 +60,15 @@ def make_dp_shardmap_train_step(model, optimizer, mesh: Mesh,
     BOTH axes and the gradient merge becomes
     :func:`~sparkflow_tpu.parallel.collectives.hierarchical_psum_mean` —
     reduce_scatter inside each slice over ICI, a 1/n_ici-sized all-reduce
-    across slices over DCN, all_gather back. Numerics are identical to the
-    flat psum; the cross-slice wire traffic drops by the ICI axis size.
+    across slices over DCN, all_gather back. Mathematically equivalent to
+    the flat psum (bitwise differences from the changed reduction order
+    stay within the pinned parity tolerance); the cross-slice wire traffic
+    drops by the ICI axis size.
     """
     from ..core import make_feeds_builder
     from .collectives import hierarchical_psum_mean
     build_feeds = make_feeds_builder(input_name, label_name)
-    if dcn_axis is not None and dcn_axis not in mesh.axis_names:
-        # silently downgrading a typo'd axis would replicate the batch over
-        # the real dcn axis (redundant identical updates per slice)
-        raise ValueError(
-            f"dcn_axis={dcn_axis!r} is not a mesh axis "
-            f"{list(mesh.axis_names)}")
+    _check_dcn_axis(mesh, dp_axis, dcn_axis)
     two_level = dcn_axis is not None
     axes = (dcn_axis, dp_axis) if two_level else (dp_axis,)
     data_spec = P(axes if two_level else dp_axis)
@@ -90,3 +105,78 @@ def make_dp_shardmap_train_step(model, optimizer, mesh: Mesh,
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_dp_zero1_train_step(model, optimizer, mesh: Mesh,
+                             input_name, label_name: Optional[str],
+                             dp_axis: str = "dp",
+                             dcn_axis: Optional[str] = None,
+                             _raw: bool = False):
+    """The ZeRO-1 form of :func:`make_dp_shardmap_train_step`: gradients
+    reduce-SCATTER over ``dp_axis`` instead of all-reducing, the optimizer
+    update runs on each device's 1/dp shard of the (flattened) params with
+    the optimizer state sharded the same way, and the updated params
+    all-gather back (Xu et al., arXiv:2004.13336). Same signature and — up
+    to reduction-order float effects — the same numerics as the replicated
+    step, with per-device optimizer-state memory cut by ~dp.
+
+    ``optimizer`` is the plain (unwrapped) transformation; callers build the
+    matching sharded state with
+    ``sharded_update(optimizer, mesh.shape[dp_axis], dp_axis).init(params)``
+    (optionally :func:`~sparkflow_tpu.optimizers_sharded.place_zero1_state`
+    so the leaves physically shard). ``dcn_axis`` composes with the
+    hierarchical two-stage reduction exactly like the replicated step: the
+    scattered 1/dp shard is what crosses the slow DCN hop, and the state
+    replicates across slices while sharding within each.
+
+    ``_raw=True`` returns the un-jitted stepper (shard_map applied, no jit)
+    for slotting into the trainer's epoch ``step_fn`` machinery.
+    """
+    from ..core import make_feeds_builder
+    from ..optimizers_sharded import sharded_update, zero1_state_specs
+    build_feeds = make_feeds_builder(input_name, label_name)
+    _check_dcn_axis(mesh, dp_axis, dcn_axis)
+    if dp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"dp_axis={dp_axis!r} is not a mesh axis "
+            f"{list(mesh.axis_names)}")
+    n_shards = mesh.shape[dp_axis]
+    two_level = dcn_axis is not None
+    axes = (dcn_axis, dp_axis) if two_level else (dp_axis,)
+    data_spec = P(axes if two_level else dp_axis)
+    wrapped = sharded_update(optimizer, n_shards, dp_axis, dcn_axis)
+
+    def step(params, opt_state, x, y, mask, rng):
+        for a in axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(a))
+
+        def local_sum(p):
+            lv = model.loss_vector(p, build_feeds(x, y), train=True, rng=rng)
+            return jnp.sum(lv * mask)
+
+        s, grads = jax.value_and_grad(local_sum)(params)
+        n = jnp.maximum(jax.lax.psum(jnp.sum(mask), axes), 1.0)
+        loss = jax.lax.psum(s, axes) / n
+        # the 1/n mean-normalization applies AFTER the scatter-sum (inside
+        # sharded_update), matching the replicated step's psum(g) / n
+        # rounding instead of summing pre-scaled addends
+        updates, opt_state = wrapped.update(grads, opt_state, params,
+                                            scale=1.0 / n)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def stepper(params, opt_state, x, y, mask, rng):
+        # the opt-state spec tree depends on the state's structure, which is
+        # only known at call time — built per call (cheap; under jit this
+        # traces once per structure anyway)
+        opt_spec = zero1_state_specs(opt_state, n_shards, dp_axis)
+        sm = shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), opt_spec, data_spec, data_spec, data_spec, P()),
+            out_specs=(P(), opt_spec, P()),
+            check_vma=False)
+        return sm(params, opt_state, x, y, mask, rng)
+
+    if _raw:
+        return stepper
+    return jax.jit(stepper, donate_argnums=(0, 1))

@@ -23,7 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from ..jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .tp import filter_pspec, shard_params

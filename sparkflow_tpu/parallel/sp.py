@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..jax_compat import shard_map
 
 
 def make_sp_train_step(model, optimizer, mesh: Mesh, dp_axis: Optional[str] = "dp",

@@ -250,6 +250,12 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # batch divides) and schedule ('gpipe' | '1f1b' | 'sequential')
     ppMicrobatches = Param(Params._dummy(), "ppMicrobatches", "", typeConverter=TypeConverters.toInt)
     ppSchedule = Param(Params._dummy(), "ppSchedule", "", typeConverter=TypeConverters.toString)
+    # upgrade: ZeRO-1 weight-update sharding on pure-dp meshes ('auto' |
+    # 'on' | 'off'): reduce-scatter gradients, run the optimizer on a 1/dp
+    # shard of params+state, all-gather the updated params — ~1/dp
+    # optimizer-state memory per device, same collective bytes. 'auto' turns
+    # on when the optimizer carries per-param state and dp >= 2.
+    weightUpdateSharding = Param(Params._dummy(), "weightUpdateSharding", "", typeConverter=TypeConverters.toString)
 
     @keyword_only
     def __init__(self,
@@ -283,7 +289,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  meshShape=None,
                  useEmaWeights=None,
                  ppMicrobatches=None,
-                 ppSchedule=None):
+                 ppSchedule=None,
+                 weightUpdateSharding=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -302,7 +309,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          fitMode='collect', extraInputCols=None,
                          extraTfInputs=None, meshShape=None,
                          useEmaWeights=False, ppMicrobatches=-1,
-                         ppSchedule='gpipe')
+                         ppSchedule='gpipe', weightUpdateSharding='auto')
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -339,7 +346,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                   meshShape=None,
                  useEmaWeights=None,
                  ppMicrobatches=None,
-                 ppSchedule=None):
+                 ppSchedule=None,
+                 weightUpdateSharding=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -460,6 +468,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             raise ValueError(
                 "ppSchedule must be 'gpipe', '1f1b', or 'sequential'; got %r"
                 % sched)
+        wus = _opt_param(self, self.weightUpdateSharding, "auto") or "auto"
+        if wus not in ("auto", "on", "off"):
+            raise ValueError(
+                "weightUpdateSharding must be 'auto', 'on', or 'off'; got %r"
+                % wus)
         if self.getOrDefault(self.useEmaWeights):
             # fail BEFORE training, not after hours of fit: the EMA only
             # exists when the optimizer maintains it (build_optimizer
@@ -534,6 +547,14 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                                                  -1) or -1) < 1
                              else _opt_param(self, self.ppMicrobatches)),
             pp_schedule=_opt_param(self, self.ppSchedule, "gpipe") or "gpipe",
+            weight_update_sharding=(_opt_param(self, self.weightUpdateSharding,
+                                               "auto") or "auto"),
+            # alongside the built optax object so the zero1 'auto' gate can
+            # see clip_norm / ema_decay
+            optimizer_options=(json.loads(optimizer_options)
+                               if isinstance(optimizer_options, str)
+                               and optimizer_options
+                               else optimizer_options),
         )
         if fit_mode == "stream":
             # one epoch = one pass over rdd.toLocalIterator(): the dataset

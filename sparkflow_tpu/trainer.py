@@ -117,7 +117,8 @@ class Trainer:
                  rng_impl: Optional[str] = None,
                  halt_on_nan: bool = False,
                  pp_microbatches: Optional[int] = None,
-                 pp_schedule: str = "gpipe"):
+                 pp_schedule: str = "gpipe",
+                 weight_update_sharding: str = "auto"):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -140,8 +141,14 @@ class Trainer:
         self.label_name = label_name
         if isinstance(optimizer, str):
             self.optimizer = build_optimizer(optimizer, learning_rate, optimizer_options)
+            self._opt_cfg = dict(optimizer_options or {})
         else:
             self.optimizer = optimizer
+            # optax object: optimizer_options (when the caller passes it
+            # alongside, as the estimator does) still informs the zero1
+            # 'auto' gate; otherwise the object is opaque
+            self._opt_cfg = (dict(optimizer_options) if optimizer_options
+                             else None)
         self.iters = iters
         self.mini_batch_size = mini_batch_size
         self.mini_stochastic_iters = mini_stochastic_iters
@@ -163,6 +170,17 @@ class Trainer:
         # schedule ('gpipe' | '1f1b' | 'sequential' — parallel/pp.py)
         self.pp_microbatches = pp_microbatches
         self.pp_schedule = pp_schedule
+        # ZeRO-1 weight-update sharding on pure-dp meshes (optimizers_sharded):
+        # 'auto' turns on when the optimizer carries per-param state and
+        # dp >= 2 (and nothing standard-layout-dependent like clip_norm /
+        # ema_decay is configured); 'on' forces it where eligible (warns and
+        # falls back otherwise); 'off' keeps the replicated update
+        if weight_update_sharding not in ("auto", "on", "off"):
+            raise ValueError(
+                f"weight_update_sharding must be 'auto', 'on', or 'off'; "
+                f"got {weight_update_sharding!r}")
+        self.weight_update_sharding = weight_update_sharding
+        self._zero1_active = False
         # divergence detection: a non-finite epoch loss always WARNS
         # (post-hoc on the fused path); halt_on_nan=True additionally stops
         # the fit at that epoch, returning the state from before the NaN
@@ -377,6 +395,88 @@ class Trainer:
     def _dp_size(self) -> int:
         from .parallel.mesh import mesh_axis_size
         return mesh_axis_size(self.mesh, "dp")
+
+    # -- ZeRO-1 weight-update sharding (optimizers_sharded) -----------------
+
+    def _resolve_zero1(self, strategy: str, pspecs, params) -> bool:
+        """Decide whether this fit shards the weight update over dp.
+
+        Eligible: default (pure-dp) strategy, replicated params (on tp/fsdp
+        meshes the opt state already shards WITH the params — zero1 would be
+        a no-op at best), and dp >= 2. 'auto' additionally requires the
+        optimizer to carry per-param state (there is nothing to shard for
+        sgd) and declines when clip_norm / ema_decay are configured: the
+        global-norm clip would measure only its shard's norm, and EMA
+        extraction expects the standard layout.
+        """
+        mode = self.weight_update_sharding
+        if mode == "off":
+            return False
+        eligible = (strategy == "default" and pspecs is None
+                    and self.mesh is not None
+                    and "dp" in self.mesh.axis_names
+                    and self._dp_size() >= 2)
+        cfg = self._opt_cfg or {}
+        blocked = [k for k in ("clip_norm", "ema_decay") if cfg.get(k)]
+        if mode == "on":
+            if not eligible:
+                logger.warning(
+                    "weight_update_sharding='on' needs a pure-dp fit on a "
+                    "mesh with dp >= 2 (got strategy=%r, sharded-params=%s, "
+                    "dp=%d); training with the replicated update", strategy,
+                    pspecs is not None, self._dp_size())
+                return False
+            if blocked:
+                logger.warning(
+                    "weight_update_sharding='on' is incompatible with %s "
+                    "(shard-local update would break their global-layout "
+                    "math); training with the replicated update", blocked)
+                return False
+            return True
+        # auto
+        if not eligible or blocked:
+            return False
+        from .optimizers_sharded import has_per_param_state
+        return has_per_param_state(self.optimizer, params)
+
+    def _make_zero1_step(self):
+        """The per-batch step_fn for the epoch machinery: the raw zero1
+        stepper runs its own shard_map, so — exactly like the pp/sp strategy
+        steps — it must run under unsharded_attention (re-wrapping the
+        attention kernel over the same mesh axes is invalid)."""
+        from .ops.attention import unsharded_attention
+        from .parallel.dp import make_dp_zero1_train_step
+        raw = make_dp_zero1_train_step(self.model, self.optimizer, self.mesh,
+                                       self.input_name, self.label_name,
+                                       _raw=True)
+
+        def step_fn(p, o, x, y, m, r):
+            with unsharded_attention():
+                return raw(p, o, x, y, m, r)
+
+        return step_fn
+
+    def _opt_to_ckpt(self, params, opt_state):
+        """Checkpoints always hold the STANDARD (param-shaped) opt state, so
+        directories stay interchangeable between zero1-on/off runs and
+        across mesh-shape changes."""
+        if not self._zero1_active:
+            return opt_state
+        from .optimizers_sharded import gather_zero1_state
+        return gather_zero1_state(self.optimizer, params, opt_state,
+                                  self._dp_size())
+
+    def _opt_from_ckpt(self, params, opt_state):
+        """Restore-side inverse of :meth:`_opt_to_ckpt`: re-pad and re-shard
+        the standard state for THIS mesh's dp size (which may differ from
+        the writing run's) and place the shards."""
+        if not self._zero1_active:
+            return opt_state
+        from .optimizers_sharded import place_zero1_state, shard_zero1_state
+        dp_n = self._dp_size()
+        return place_zero1_state(
+            shard_zero1_state(self.optimizer, params, opt_state, dp_n),
+            self.mesh, dp_n)
 
     def _plan(self, n: int):
         """Resolve (mode, batch_size, num_batches) from the reference's three
@@ -596,7 +696,21 @@ class Trainer:
             # tp/fsdp: place params per their PartitionSpecs BEFORE the
             # optimizer init so mu/nu/etc inherit the same placement
             params = self._place_params(params, pspecs)
-        opt_state = self.optimizer.init(params)
+        self._zero1_active = self._resolve_zero1(strategy, pspecs, params)
+        opt_shardings = None
+        if self._zero1_active:
+            # ZeRO-1: the state is built in the flat [dp, s]-leaf layout and
+            # physically sharded over dp; the epoch program pins that
+            # placement (opt_shardings) so donation round-trips keep it
+            from .optimizers_sharded import (place_zero1_state, sharded_update,
+                                             zero1_state_shardings)
+            dp_n = self._dp_size()
+            wrapped = sharded_update(self.optimizer, dp_n, "dp")
+            opt_state = place_zero1_state(wrapped.init(params), self.mesh,
+                                          dp_n)
+            opt_shardings = zero1_state_shardings(opt_state, self.mesh, dp_n)
+        else:
+            opt_state = self.optimizer.init(params)
 
         ckpt_mgr = None
         start_epoch = 0
@@ -607,11 +721,14 @@ class Trainer:
             # host-side structural template, captured BEFORE any donation can
             # invalidate device buffers (restore-after-failure needs it)
             ckpt_like = jax.tree.map(
-                np.asarray, _ckpt_state(params, opt_state, 0, rng, rng_impl=self.rng_impl))
+                np.asarray, _ckpt_state(params,
+                                        self._opt_to_ckpt(params, opt_state),
+                                        0, rng, rng_impl=self.rng_impl))
             state = self._ckpt_restore(ckpt_mgr, ckpt_like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
-                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                opt_state = self._opt_from_ckpt(
+                    params, jax.tree.map(jnp.asarray, state["opt_state"]))
                 if pspecs is not None:
                     # restored arrays are host-loaded; re-place params (the
                     # opt state re-places lazily via inferred shardings on
@@ -637,8 +754,12 @@ class Trainer:
         # remaining epoch as ONE compiled program (lax.scan over the epoch
         # body; single device dispatch for the whole fit). Per-epoch rngs are
         # generated exactly like the loop below, so losses match it.
-        step_fn = (self._make_strategy_step(strategy, task, batch)
-                   if strategy != "default" else None)
+        if strategy != "default":
+            step_fn = self._make_strategy_step(strategy, task, batch)
+        elif self._zero1_active:
+            step_fn = self._make_zero1_step()
+        else:
+            step_fn = None
         k = total_epochs - start_epoch
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
@@ -646,14 +767,16 @@ class Trainer:
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
                     pspecs is not None, strategy,
-                    self.pp_schedule, self.pp_microbatches)
+                    self.pp_schedule, self.pp_microbatches,
+                    self._zero1_active)
             if fkey not in self._epoch_cache:
                 loss_fn = make_loss_fn(self.model, self.input_name,
                                        self.label_name)
                 self._epoch_cache[fkey] = make_multi_epoch_fn(
                     loss_fn, self.optimizer, batch, num_batches, mode,
                     self.shuffle_per_iter, k, self.mesh, n_real=n,
-                    infer_params=pspecs is not None, step_fn=step_fn)
+                    infer_params=pspecs is not None, step_fn=step_fn,
+                    opt_shardings=opt_shardings)
             erngs = []
             for _ in range(k):
                 rng, erng = jax.random.split(rng)
@@ -675,13 +798,15 @@ class Trainer:
 
         cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
                      n if mode == "stochastic" else None, pspecs is not None,
-                     strategy, self.pp_schedule, self.pp_microbatches)
+                     strategy, self.pp_schedule, self.pp_microbatches,
+                     self._zero1_active)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
             self._epoch_cache[cache_key] = make_epoch_fn(
                 loss_fn, self.optimizer, batch, num_batches, mode,
                 self.shuffle_per_iter, self.mesh, n_real=n,
-                infer_params=pspecs is not None, step_fn=step_fn)
+                infer_params=pspecs is not None, step_fn=step_fn,
+                opt_shardings=opt_shardings)
         epoch_fn = self._epoch_cache[cache_key]
 
         from .utils.preempt import NullGuard, PreemptionGuard
@@ -703,7 +828,9 @@ class Trainer:
                             # checkpoint
                             at = max(it, start_epoch)
                             ckpt_mgr.save(
-                                at, _ckpt_state(params, opt_state, at, rng, rng_impl=self.rng_impl))
+                                at, _ckpt_state(params,
+                                                self._opt_to_ckpt(params, opt_state),
+                                                at, rng, rng_impl=self.rng_impl))
                             logger.warning(
                                 "preempted: checkpoint saved at epoch %d", at)
                             preempted = True
@@ -762,7 +889,9 @@ class Trainer:
                                 and (it % self.checkpoint_every == 0
                                      or it == total_epochs)):
                             ckpt_mgr.save(
-                                it, _ckpt_state(params, opt_state, it, rng, rng_impl=self.rng_impl))
+                                it, _ckpt_state(params,
+                                                self._opt_to_ckpt(params, opt_state),
+                                                it, rng, rng_impl=self.rng_impl))
                     if preempted:
                         break
                 break
@@ -778,7 +907,8 @@ class Trainer:
                     raise
                 retries_left -= 1
                 params = jax.tree.map(jnp.asarray, state["params"])
-                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                opt_state = self._opt_from_ckpt(
+                    params, jax.tree.map(jnp.asarray, state["opt_state"]))
                 start_epoch = int(state["epoch"])
                 rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 # epochs past the restore point will re-run: drop their losses
@@ -814,7 +944,14 @@ class Trainer:
         if self._last_opt_state is None:
             return None
         from .optimizers import extract_ema_params
-        ema = extract_ema_params(self._last_opt_state)
+        state = self._last_opt_state
+        if self._zero1_active and self.params is not None:
+            # defensive: zero1 'auto' declines when ema_decay is configured,
+            # but a hand-built optax chain can slip past the config gate —
+            # EMA leaves then live in the flat [dp, s] layout and need the
+            # standard-form conversion before extraction
+            state = self._opt_to_ckpt(self.params, state)
+        ema = extract_ema_params(state)
         if ema is not None and self.mesh is not None \
                 and self._mesh_strategy() == "pp":
             # the pp opt state tracks the stage-stacked layout; serve the
@@ -892,10 +1029,25 @@ class Trainer:
             # streaming honors tp/fsdp sharding exactly like fit(): place
             # params first so the optimizer state inherits the placement
             params = self._place_params(params, pspecs)
-        opt_state = self.optimizer.init(params)
-        loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
-        step = make_train_step(loss_fn, self.optimizer, self.mesh,
-                               infer_params=pspecs is not None)
+        self._zero1_active = self._resolve_zero1("default", pspecs, params)
+        if self._zero1_active:
+            # same zero1 wiring as fit(): sharded state, reduce_scatter step
+            # (make_dp_zero1_train_step has make_train_step's signature)
+            from .optimizers_sharded import place_zero1_state, sharded_update
+            from .parallel.dp import make_dp_zero1_train_step
+            dp_n = self._dp_size()
+            wrapped = sharded_update(self.optimizer, dp_n, "dp")
+            opt_state = place_zero1_state(wrapped.init(params), self.mesh,
+                                          dp_n)
+            step = make_dp_zero1_train_step(
+                self.model, self.optimizer, self.mesh, self.input_name,
+                self.label_name)
+        else:
+            opt_state = self.optimizer.init(params)
+            loss_fn = make_loss_fn(self.model, self.input_name,
+                                   self.label_name)
+            step = make_train_step(loss_fn, self.optimizer, self.mesh,
+                                   infer_params=pspecs is not None)
 
         ckpt_mgr = None
         start_step = 0
@@ -907,11 +1059,14 @@ class Trainer:
             from .checkpoint import CheckpointManager
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
             like = jax.tree.map(
-                np.asarray, _ckpt_state(params, opt_state, 0, rng, rng_impl=self.rng_impl))
+                np.asarray, _ckpt_state(params,
+                                        self._opt_to_ckpt(params, opt_state),
+                                        0, rng, rng_impl=self.rng_impl))
             state = self._ckpt_restore(ckpt_mgr, like)
             if state is not None:
                 params = jax.tree.map(jnp.asarray, state["params"])
-                opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+                opt_state = self._opt_from_ckpt(
+                    params, jax.tree.map(jnp.asarray, state["opt_state"]))
                 if pspecs is not None:
                     params = self._place_params(params, pspecs)
                 start_step = int(state["epoch"])
@@ -937,8 +1092,8 @@ class Trainer:
                     # contract as the in-loop check
                     if ckpt_mgr is not None and not preempt_saved:
                         ckpt_mgr.save(it_count, _ckpt_state(
-                            params, opt_state, it_count, rng,
-                            rng_impl=self.rng_impl))
+                            params, self._opt_to_ckpt(params, opt_state),
+                            it_count, rng, rng_impl=self.rng_impl))
                         logger.warning("preempted: checkpoint saved at "
                                        "stream step %d", it_count)
                     break
@@ -988,8 +1143,9 @@ class Trainer:
                             # caller's iterator factory re-pulls the source)
                             if ckpt_mgr is not None:
                                 ckpt_mgr.save(it_count, _ckpt_state(
-                                    params, opt_state, it_count, rng,
-                                    rng_impl=self.rng_impl))
+                                    params,
+                                    self._opt_to_ckpt(params, opt_state),
+                                    it_count, rng, rng_impl=self.rng_impl))
                                 preempt_saved = True
                             logger.warning("preempted: stopping stream at step "
                                            "%d", it_count)
@@ -1024,8 +1180,8 @@ class Trainer:
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and it_count % self.checkpoint_every == 0):
                             ckpt_mgr.save(it_count, _ckpt_state(
-                                params, opt_state, it_count, rng,
-                                rng_impl=self.rng_impl))
+                                params, self._opt_to_ckpt(params, opt_state),
+                                it_count, rng, rng_impl=self.rng_impl))
                     feeder.join()
                     if nan_halted:
                         break

@@ -23,6 +23,11 @@ from sparkflow_tpu.tensorflow_async import SparkAsyncDL, SparkAsyncDLModel
 
 random.seed(12345)
 
+# Full Spark-session end-to-end fits: far too slow for the tier-1 wall-clock
+# budget (each test spins a LocalSession fit/transform cycle). Run explicitly
+# with `-m slow` or by file path.
+pytestmark = pytest.mark.slow
+
 
 # -- model builders (reference dl_runner.py:42-73) ---------------------------
 

@@ -48,6 +48,8 @@ def test_example_guards_against_wedged_relay(fname):
         f"on a wedged TPU relay instead of falling back to CPU")
 
 
+@pytest.mark.slow  # full end-to-end subprocess train per example: minutes of
+# wall clock across the matrix — out of the tier-1 budget, run with `-m slow`
 @pytest.mark.parametrize("fname", _example_files())
 def test_example_executes(fname, tmp_path):
     """Run the example's real ``__main__`` path to completion (smoke mode,

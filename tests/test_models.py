@@ -110,7 +110,7 @@ def test_sp_forward_matches_single_device_logits():
     """Regression: under sp, shard i must use GLOBAL positions i*S_local..;
     amplified pos table + trained-scale comparison catches local-offset bugs."""
     import copy
-    from jax import shard_map
+    from sparkflow_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh({"sp": 8})
@@ -135,7 +135,7 @@ def test_sp_forward_matches_single_device_logits():
 
 
 def test_ring_attention_respects_kv_mask():
-    from jax import shard_map
+    from sparkflow_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from sparkflow_tpu.ops import attention_reference, ring_attention
     from jax.sharding import Mesh

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from sparkflow_tpu.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from sparkflow_tpu.ops import attention_reference, flash_attention, ring_attention
@@ -196,7 +196,7 @@ def test_flash_bwd_bf16():
 def test_ring_flash_matches_ring_and_reference(dp_mesh):
     """ring_flash_attention (pallas per-visit blocks + lse merge) must equal
     plain ring attention and the dense reference, causal and not, fwd + bwd."""
-    from jax import shard_map
+    from sparkflow_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from sparkflow_tpu.ops import ring_flash_attention
 
@@ -236,7 +236,7 @@ def test_ring_flash_matches_ring_and_reference(dp_mesh):
 def test_ring_flash_kv_mask_path(dp_mesh):
     """The mask carry (mc rotating the ring into the kernel's mask BlockSpec)
     — the genuinely new data flow — causal and not."""
-    from jax import shard_map
+    from sparkflow_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from sparkflow_tpu.ops import ring_flash_attention
 
