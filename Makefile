@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -47,6 +47,26 @@ native:
 clean:
 	rm -rf sparkflow_tpu/native/_build .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+# end-to-end serving smoke: start an InferenceServer on an ephemeral port,
+# send one request through ServingClient, assert the prediction shape, stop
+serve-smoke:
+	PYTHONPATH=".:$$PYTHONPATH" python -c "\
+	import numpy as np; \
+	import sparkflow_tpu.nn as nn; \
+	from sparkflow_tpu.graph_utils import build_graph; \
+	from sparkflow_tpu.serving import InferenceEngine, InferenceServer, ServingClient; \
+	g = lambda: (lambda x: nn.dense(nn.dense(x, 8, activation='relu'), 2, name='out'))(nn.placeholder([None, 4], name='x')); \
+	rs = np.random.RandomState(0); \
+	w = [rs.randn(4, 8).astype(np.float32), rs.randn(8).astype(np.float32), rs.randn(8, 2).astype(np.float32), rs.randn(2).astype(np.float32)]; \
+	eng = InferenceEngine(build_graph(g), w, input_name='x:0', output_name='out/BiasAdd:0', max_batch=8); \
+	srv = InferenceServer(eng, max_delay_ms=1.0).start(); \
+	c = ServingClient(srv.url); \
+	assert c.healthz()['status'] == 'ok'; \
+	p = c.predict(rs.randn(3, 4).tolist()); \
+	assert p.shape == (3, 2), p.shape; \
+	srv.stop(); \
+	print('serve-smoke OK: 3x2 prediction served at', srv.url)"
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:

@@ -26,6 +26,9 @@ Public surface (mirrors the reference module-for-module):
   ring attention; the distributed backend replacing the HTTP parameter server)
 - :mod:`sparkflow_tpu.models`        — registry model zoo (MLP, CNN, autoencoder,
   ResNet, BERT)
+- :mod:`sparkflow_tpu.serving`       — online inference: AOT bucket engine,
+  micro-batcher, JSON-HTTP front (beyond the reference, whose only inference
+  path is the offline batch transform)
 """
 
 __version__ = "0.1.0"

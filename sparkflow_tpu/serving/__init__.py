@@ -1,0 +1,33 @@
+"""Online serving: the request/response half of the framework.
+
+The reference's only inference path is the offline Spark batch transform
+(``sparkflow/ml_util.py:54-83`` via ``SparkAsyncDLModel._transform``); its one
+online process is the *training-side* driver-hosted Flask parameter server
+(``sparkflow/HogwildSparkModel.py:156-166``). This package is the serving-side
+analogue the ROADMAP north star ("serves heavy traffic from millions of
+users") requires:
+
+- :class:`~sparkflow_tpu.serving.engine.InferenceEngine` — loads a trained
+  model, AOT-compiles (``jit(...).lower().compile()``) the apply function for
+  a ladder of padded batch-size buckets so steady-state serving never
+  recompiles, shards batches over a ``dp`` mesh, serves int8
+  (``utils.quant``) when asked.
+- :class:`~sparkflow_tpu.serving.batcher.MicroBatcher` — coalesces concurrent
+  requests under a deadline into one device batch (the SparkNet lever,
+  arXiv:1511.06051: amortize fixed per-call overhead by batching before the
+  accelerator), with bounded-queue backpressure
+  (:class:`~sparkflow_tpu.serving.batcher.QueueFull`).
+- :class:`~sparkflow_tpu.serving.server.InferenceServer` /
+  :class:`~sparkflow_tpu.serving.client.ServingClient` — a stdlib JSON-HTTP
+  front (``/v1/predict``, ``/healthz``, ``/metrics``) and its tiny client.
+
+See ``docs/serving.md`` and ``examples/serving_example.py``.
+"""
+
+from .batcher import MicroBatcher, QueueFull
+from .client import ServingClient, ServingError
+from .engine import InferenceEngine
+from .server import InferenceServer
+
+__all__ = ["InferenceEngine", "MicroBatcher", "QueueFull",
+           "InferenceServer", "ServingClient", "ServingError"]

@@ -1,0 +1,226 @@
+"""Deadline-based micro-batching for concurrent inference requests.
+
+Per-request device dispatch wastes the accelerator: each call pays the fixed
+host-side overhead (python → runtime → device and back) for a handful of rows.
+The SparkNet observation (arXiv:1511.06051) is that the fix for exactly this
+shape of overhead is batching work before it reaches the device — here applied
+on the serving side. The :class:`MicroBatcher` coalesces requests that arrive
+within a small deadline window (``max_delay_ms``) into one engine call of up
+to ``max_batch`` rows, then fans the rows of the batched output back out to
+per-request futures.
+
+Backpressure is explicit: the pending-row queue is bounded, and submissions
+beyond the bound raise :class:`QueueFull` immediately instead of stretching
+tail latency without limit. The HTTP front maps that to a structured 503.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import metrics as metrics_mod
+
+
+class QueueFull(Exception):
+    """Raised by :meth:`MicroBatcher.submit` when the pending queue is at
+    capacity — the caller should shed the request (HTTP 503), not wait."""
+
+
+class _Pending:
+    __slots__ = ("rows", "future", "enqueued_at")
+
+    def __init__(self, rows, future, enqueued_at):
+        self.rows = rows
+        self.future = future
+        self.enqueued_at = enqueued_at
+
+
+class MicroBatcher:
+    """Thread-safe request coalescer in front of an
+    :class:`~sparkflow_tpu.serving.engine.InferenceEngine`.
+
+    Parameters
+    ----------
+    engine : object
+        Anything with a ``predict(x) -> np.ndarray`` that maps rows to rows
+        (row i of the output answers row i of the input).
+    max_batch : int | None
+        Rows per engine call; defaults to ``engine.max_batch``.
+    max_delay_ms : float
+        How long the worker waits for co-riders once a request is pending.
+        0 disables coalescing delay (still batches whatever is queued).
+    max_queue : int
+        Bound on queued rows (excluding the batch in flight). Submissions
+        that would exceed it raise :class:`QueueFull`.
+    """
+
+    def __init__(self, engine, *, max_batch: Optional[int] = None,
+                 max_delay_ms: float = 2.0, max_queue: int = 1024,
+                 metrics: Optional[metrics_mod.Metrics] = None):
+        self.engine = engine
+        self.max_batch = int(max_batch if max_batch is not None
+                             else getattr(engine, "max_batch", 64))
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_queue = int(max_queue)
+        self.metrics = (metrics if metrics is not None
+                        else getattr(engine, "metrics", None)
+                        or metrics_mod.Metrics())
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_Pending] = []
+        self._queued_rows = 0
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop,
+                                        name="microbatcher", daemon=True)
+        self._worker.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, x) -> "Future[np.ndarray]":
+        """Queue one request (``[n, ...]`` array, or one unbatched row, or a
+        tuple of arrays for multi-input engines) and return a Future that
+        resolves to its rows of the batched output."""
+        rows = self._as_rows(x)
+        n = rows[0].shape[0]
+        if n > self.max_batch:
+            raise ValueError(
+                f"request of {n} rows exceeds max_batch={self.max_batch}; "
+                f"split it client-side or call engine.predict directly")
+        fut: "Future[np.ndarray]" = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            if self._queued_rows + n > self.max_queue:
+                self.metrics.incr("serving/queue_rejections")
+                raise QueueFull(
+                    f"queue at capacity ({self._queued_rows}/{self.max_queue}"
+                    f" rows); retry later")
+            self._pending.append(_Pending(rows, fut, time.perf_counter()))
+            self._queued_rows += n
+            self.metrics.observe("serving/queue_depth_rows",
+                                 self._queued_rows)
+            self._cond.notify()
+        return fut
+
+    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapper: ``submit(x).result(timeout)``."""
+        return self.submit(x).result(timeout)
+
+    def close(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop the worker. With ``drain`` (default) queued requests are
+        served first; otherwise they fail with RuntimeError."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                for p in self._pending:
+                    p.future.set_exception(
+                        RuntimeError("MicroBatcher closed"))
+                self._pending.clear()
+                self._queued_rows = 0
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def depth(self) -> int:
+        """Rows currently queued (diagnostics / tests)."""
+        with self._lock:
+            return self._queued_rows
+
+    # -- worker side ---------------------------------------------------------
+
+    def _as_rows(self, x) -> Tuple[np.ndarray, ...]:
+        multi = bool(getattr(self.engine, "_multi", False))
+        xs = (tuple(np.asarray(a) for a in x) if multi
+              else (np.asarray(x),))
+        shapes = getattr(self.engine, "_in_shapes", None)
+        if shapes is not None and xs[0].ndim == len(shapes[0]):
+            xs = tuple(a[None] for a in xs)  # single unbatched row
+        n = xs[0].shape[0]
+        if any(a.shape[0] != n for a in xs):
+            raise ValueError("multi-input arrays must share the batch dim")
+        if n == 0:
+            raise ValueError("empty request")
+        return xs
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block until there is work (or close), wait out the coalescing
+        deadline, then pop up to max_batch rows worth of whole requests."""
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return None  # closed and drained
+            if self.max_delay_ms > 0:
+                oldest = self._pending[0].enqueued_at
+                deadline = oldest + self.max_delay_ms / 1000.0
+                while (self._queued_rows < self.max_batch
+                       and not self._closed):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            batch, rows = [], 0
+            while self._pending:
+                n = self._pending[0].rows[0].shape[0]
+                if batch and rows + n > self.max_batch:
+                    break
+                p = self._pending.pop(0)
+                batch.append(p)
+                rows += n
+            self._queued_rows -= rows
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._serve(batch)
+
+    def _serve(self, batch: List[_Pending]) -> None:
+        sizes = [p.rows[0].shape[0] for p in batch]
+        total = sum(sizes)
+        multi = len(batch[0].rows) > 1
+        try:
+            joined = tuple(
+                np.concatenate([p.rows[i] for p in batch], axis=0)
+                for i in range(len(batch[0].rows)))
+            t0 = time.perf_counter()
+            out = self.engine.predict(joined if multi else joined[0])
+            dt = time.perf_counter() - t0
+        except Exception as exc:  # noqa: BLE001 - fan the failure out
+            for p in batch:
+                if not p.future.cancelled():
+                    p.future.set_exception(exc)
+            self.metrics.incr("serving/batch_errors")
+            return
+        self.metrics.observe("serving/batch_rows", total)
+        self.metrics.observe("serving/batch_fill_ratio",
+                             total / self.max_batch)
+        self.metrics.observe("serving/batch_latency_ms", dt * 1000.0)
+        self.metrics.incr("serving/batches")
+        self.metrics.incr("serving/requests", len(batch))
+        offset = 0
+        now = time.perf_counter()
+        for p, n in zip(batch, sizes):
+            self.metrics.observe("serving/request_latency_ms",
+                                 (now - p.enqueued_at) * 1000.0)
+            if not p.future.cancelled():
+                p.future.set_result(out[offset:offset + n])
+            offset += n

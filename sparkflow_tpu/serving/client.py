@@ -1,0 +1,70 @@
+"""Minimal stdlib client for :class:`~sparkflow_tpu.serving.server.InferenceServer`.
+
+Deliberately tiny — ``urllib.request`` plus JSON — because its jobs are the
+smoke path (``make serve-smoke``), the e2e tests, and showing the wire
+protocol in ~30 lines. Production callers can speak the same JSON from any
+HTTP stack.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class ServingError(Exception):
+    """Non-2xx reply from the server. Carries the structured error body."""
+
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"HTTP {status} [{code}]: {message}")
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class ServingClient:
+    """``ServingClient(url).predict(rows)`` → np.ndarray of predictions."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, path: str, payload: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self.url + path,
+            data=(json.dumps(payload).encode("utf-8")
+                  if payload is not None else None),
+            headers={"Content-Type": "application/json"},
+            method="POST" if payload is not None else "GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            try:
+                err = json.loads(exc.read().decode("utf-8"))["error"]
+                raise ServingError(exc.code, err.get("code", "unknown"),
+                                   err.get("message", "")) from None
+            except (ValueError, KeyError):
+                raise ServingError(exc.code, "unknown", str(exc)) from None
+
+    def predict(self, inputs) -> np.ndarray:
+        """``inputs``: rows (list/array) or, for multi-input engines, a dict
+        of ``{input_name: rows}``. Raises :class:`ServingError` on rejection
+        (e.g. ``code == 'queue_full'`` under overload)."""
+        if isinstance(inputs, dict):
+            wire: Any = {k: np.asarray(v).tolist() for k, v in inputs.items()}
+        else:
+            wire = np.asarray(inputs).tolist()
+        reply = self._request("/v1/predict", {"inputs": wire})
+        return np.asarray(reply["predictions"])
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._request("/healthz")
+
+    def metrics(self) -> Dict[str, Any]:
+        return self._request("/metrics")

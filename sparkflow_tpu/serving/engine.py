@@ -1,0 +1,315 @@
+"""AOT-compiled online-inference engine.
+
+The offline path (:func:`sparkflow_tpu.core.make_predict_fn` +
+``predict_in_chunks``) relies on ``jax.jit``'s trace cache: the first request
+at every new batch shape pays a compile, which is fine for a Spark partition
+sweep but is a multi-second latency cliff for an online endpoint. The engine
+removes the cliff by **pre-compiling** the apply function for a ladder of
+padded batch-size buckets (1, 2, 4, ... max_batch) at construction time via
+``jit(...).lower(...).compile()`` — steady-state serving then never traces or
+compiles again, whatever mix of request sizes arrives. Requests pad up to the
+nearest bucket (bounded waste: < 2x rows) and trim on return; padded rows are
+zeros, and row-independent graph evaluation means they can't perturb real
+rows' outputs.
+
+Sharding: with a multi-device ``dp`` mesh, buckets that divide over the axis
+shard their batch (params replicated, exactly like the batch-transform path);
+smaller buckets compile replicated rather than failing divisibility.
+
+Quantized serving reuses :mod:`sparkflow_tpu.utils.quant`: the engine
+quantizes the full-precision tree once at load and compiles the int8 apply —
+``weight_only`` and ``dynamic`` both serve through the same bucket ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import _sharded_trace_guard
+from ..utils import metrics as metrics_mod
+from ..utils.tracing import annotate
+
+
+def _bucket_ladder(max_batch: int) -> List[int]:
+    """1, 2, 4, ... up to max_batch (max_batch itself always included, so a
+    non-power-of-two cap still has a full-size bucket)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return buckets
+
+
+class InferenceEngine:
+    """Low-latency predictions from a trained model, no steady-state compiles.
+
+    Parameters
+    ----------
+    graph : str | model
+        Model spec JSON (nn DSL / registry spec / TF1 metagraph — anything
+        :func:`sparkflow_tpu.models.model_from_json` loads) or an already
+        constructed model object.
+    weights : list of arrays | str | params pytree | None
+        Flat weight list, the estimator's weights Param (inline JSON or
+        ``npz:<path>``), or an already-structured params pytree.
+    input_name : str | sequence of str
+        Input tensor name(s) (``'x:0'`` style); a sequence means requests
+        carry a tuple of arrays (multi-input models).
+    output_name : str
+        Output tensor to serve.
+    max_batch : int
+        Top of the bucket ladder; larger requests run in max_batch chunks.
+    mesh : jax.sharding.Mesh | None
+        dp mesh to shard batches over (params replicated).
+    quantize : None | 'weight_only' | 'dynamic'
+        int8 serving via ``utils.quant``. ``quant_min_size`` forwards to
+        :func:`~sparkflow_tpu.utils.quant.quantize_params` (kernels below it
+        stay full precision).
+    warmup : bool
+        AOT-compile every bucket at construction (default). With
+        ``warmup=False``, buckets compile on first use (each counted in
+        ``stats()['fallback_compiles']``).
+    """
+
+    def __init__(self, graph, weights=None, *,
+                 input_name: Union[str, Sequence[str]] = "x:0",
+                 output_name: str = "out:0",
+                 dropout_name: Optional[str] = None,
+                 dropout_value: float = 1.0,
+                 max_batch: int = 64,
+                 mesh=None,
+                 quantize: Optional[str] = None,
+                 quant_min_size: int = 4096,
+                 compute_dtype=None,
+                 warmup: bool = True,
+                 metrics: Optional[metrics_mod.Metrics] = None):
+        if isinstance(graph, str):
+            from ..models import model_from_json
+            self.model = model_from_json(graph, compute_dtype)
+        else:
+            self.model = graph
+        self.input_name = input_name
+        self.output_name = output_name
+        self.dropout_name = dropout_name
+        self.dropout_value = dropout_value
+        self.max_batch = int(max_batch)
+        self.mesh = mesh
+        self.quantize = quantize
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+
+        self._multi = isinstance(input_name, (list, tuple))
+        names = list(input_name) if self._multi else [input_name]
+        self._in_keys = [n.split(":")[0] for n in names]
+        # validate names against the model's tensor table up front — a typo
+        # must fail at engine construction, not on the first live request
+        for n in names + [output_name]:
+            self.model.graphdef.resolve(n)
+
+        self._params = self._load_params(weights)
+        if quantize:
+            from ..utils.quant import MODES, quantize_params
+            if quantize not in MODES:
+                raise ValueError(f"quantize must be one of {MODES} (or None), "
+                                 f"got {quantize!r}")
+            self.model.quant_mode = quantize
+            self._params = quantize_params(self._params,
+                                           min_size=quant_min_size)
+        if self.mesh is not None and self.mesh.size > 1:
+            self._params = jax.device_put(
+                self._params, NamedSharding(self.mesh, P()))
+
+        self._in_shapes, self._in_dtypes = self._input_layouts()
+        self.buckets = _bucket_ladder(self.max_batch)
+        self._compiled: Dict[int, Any] = {}
+        self._compile_lock = threading.Lock()
+        self.aot_compiles = 0
+        self.fallback_compiles = 0
+        self._requests = 0
+        self._rows = 0
+        if warmup:
+            self.warmup()
+
+    # -- loading -------------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, directory: str, graph, **kwargs
+                        ) -> "InferenceEngine":
+        """Load from a :class:`~sparkflow_tpu.checkpoint.CheckpointManager`
+        directory (``weights.npz`` export or an orbax training checkpoint)."""
+        from ..checkpoint import CheckpointManager
+        from ..models import model_from_json
+        model = (model_from_json(graph, kwargs.get("compute_dtype"))
+                 if isinstance(graph, str) else graph)
+        weights = CheckpointManager.load_weights(directory, model)
+        return cls(model, weights, **kwargs)
+
+    def _load_params(self, weights):
+        from ..graphdef import list_to_params
+        if weights is None:
+            raise ValueError("weights are required (flat list, weights JSON, "
+                             "'npz:<path>', or a params pytree)")
+        if isinstance(weights, str):
+            from ..ml_util import resolve_weights
+            weights = resolve_weights(weights)
+        if isinstance(weights, (list, tuple)):
+            return list_to_params(self.model, list(weights))
+        return weights  # already a params pytree
+
+    def _input_layouts(self) -> Tuple[List[Tuple[int, ...]], List[Any]]:
+        specs = self.model.input_specs()
+        shapes, dtypes = [], []
+        for key in self._in_keys:
+            if key not in specs:
+                raise KeyError(f"input {key!r} is not a model input; inputs: "
+                               f"{sorted(specs)}")
+            shape, dtype = specs[key]
+            if any(d is None for d in shape[1:]):
+                raise ValueError(
+                    f"input {key!r} has non-static feature dims {shape}; the "
+                    f"bucket ladder needs fully static row shapes")
+            shapes.append(tuple(int(d) for d in shape[1:]))
+            dtypes.append(np.dtype(dtype))
+        return shapes, dtypes
+
+    # -- compilation ---------------------------------------------------------
+
+    def _apply_fn(self):
+        model = self.model
+        in_keys, multi = self._in_keys, self._multi
+        drop_key = (self.dropout_name.split(":")[0]
+                    if self.dropout_name else None)
+        drop_val = self.dropout_value
+        out_name = self.output_name
+
+        def predict(params, x):
+            import jax.numpy as jnp
+            feeds = dict(zip(in_keys, tuple(x) if multi else (x,)))
+            if drop_key is not None:
+                feeds[drop_key] = jnp.asarray(drop_val, jnp.float32)
+            return model.apply(params, feeds, [out_name],
+                               train=False)[out_name]
+
+        return predict
+
+    def _x_struct(self, bucket: int):
+        structs = tuple(
+            jax.ShapeDtypeStruct((bucket,) + shape, dtype)
+            for shape, dtype in zip(self._in_shapes, self._in_dtypes))
+        return structs if self._multi else structs[0]
+
+    def _compile_bucket(self, bucket: int):
+        predict = self._apply_fn()
+        params_struct = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._params)
+        mesh = self.mesh
+        if mesh is None or mesh.size <= 1:
+            jitted = jax.jit(predict)
+        else:
+            predict = _sharded_trace_guard(predict, mesh)
+            repl = NamedSharding(mesh, P())
+            dp = mesh.shape.get("dp", 1)
+            rows = (NamedSharding(mesh, P("dp"))
+                    if "dp" in mesh.axis_names and bucket % dp == 0 and dp > 1
+                    else repl)
+            data = (jax.tree.map(lambda _: rows, self._x_struct(bucket))
+                    if self._multi else rows)
+            jitted = jax.jit(predict, in_shardings=(repl, data),
+                             out_shardings=rows)
+        return jitted.lower(params_struct, self._x_struct(bucket)).compile()
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket. Idempotent; after it returns,
+        ``predict`` never compiles for any request size."""
+        with self._compile_lock:
+            for b in self.buckets:
+                if b not in self._compiled:
+                    with annotate(f"serving/aot_compile_b{b}"):
+                        self._compiled[b] = self._compile_bucket(b)
+                    self.aot_compiles += 1
+
+    def _executable(self, bucket: int):
+        exe = self._compiled.get(bucket)
+        if exe is None:
+            # lazy path (warmup=False) or a foreign bucket — counted so tests
+            # can assert the steady state compiles nothing
+            with self._compile_lock:
+                exe = self._compiled.get(bucket)
+                if exe is None:
+                    exe = self._compiled[bucket] = self._compile_bucket(bucket)
+                    self.fallback_compiles += 1
+        return exe
+
+    # -- serving -------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    def predict(self, x) -> np.ndarray:
+        """Predict for ``x``: one array ``[n, ...]`` (or a tuple for
+        multi-input models), any ``n >= 1``. Pads to the nearest bucket;
+        requests beyond ``max_batch`` run in max_batch chunks."""
+        xs = tuple(np.asarray(a) for a in x) if self._multi \
+            else (np.asarray(x),)
+        if xs[0].ndim == len(self._in_shapes[0]):  # single unbatched row
+            xs = tuple(a[None] for a in xs)
+        for a, shape, key in zip(xs, self._in_shapes, self._in_keys):
+            if tuple(a.shape[1:]) != shape:
+                raise ValueError(
+                    f"input {key!r}: rows have shape {tuple(a.shape[1:])}, "
+                    f"model expects {shape}")
+        n = xs[0].shape[0]
+        if any(a.shape[0] != n for a in xs):
+            raise ValueError("multi-input arrays must share the batch dim")
+        if n == 0:
+            probe = self._run(tuple(a[:0] for a in xs), 0, probe_rows=1)
+            return probe[:0]
+        self._requests += 1
+        self._rows += n
+        if n > self.max_batch:
+            outs = [self._run(tuple(a[i:i + self.max_batch] for a in xs),
+                              min(self.max_batch, n - i))
+                    for i in range(0, n, self.max_batch)]
+            return np.concatenate(outs, axis=0)
+        return self._run(xs, n)
+
+    def _run(self, xs, n: int, probe_rows: int = 0) -> np.ndarray:
+        have = max(n, probe_rows)
+        bucket = self._bucket_for(have)
+        if have < bucket:
+            xs = tuple(np.concatenate(
+                [a, np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)])
+                for a in xs)
+        elif probe_rows and xs[0].shape[0] == 0:
+            xs = tuple(np.zeros((bucket,) + a.shape[1:], a.dtype) for a in xs)
+        exe = self._executable(bucket)
+        self.metrics.observe("serving/engine_batch_rows", n)
+        self.metrics.observe("serving/padding_waste",
+                             (bucket - n) / bucket if bucket else 0.0)
+        with annotate("serving/engine_apply"):
+            out = exe(self._params, xs if self._multi else xs[0])
+        return np.asarray(out)[:n]
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {"buckets": list(self.buckets),
+                "aot_compiles": self.aot_compiles,
+                "fallback_compiles": self.fallback_compiles,
+                "requests": self._requests,
+                "rows": self._rows,
+                "quantize": self.quantize,
+                "mesh": (dict(self.mesh.shape) if self.mesh is not None
+                         else None)}

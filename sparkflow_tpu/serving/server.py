@@ -1,0 +1,185 @@
+"""Stdlib JSON-over-HTTP front for the inference engine.
+
+Mirrors the reference's choice of a driver-hosted HTTP process for its
+parameter server (``sparkflow/HogwildSparkModel.py:156-166``) but on the
+serving side, and — like the rest of this repo — without taking a web
+framework dependency: ``http.server.ThreadingHTTPServer`` is enough for a
+JSON request/response front, and every handler thread funnels into the one
+:class:`~sparkflow_tpu.serving.batcher.MicroBatcher`, which is the point —
+concurrency arrives at the device as micro-batches, not as per-request calls.
+
+Endpoints
+---------
+``POST /v1/predict``
+    Body ``{"inputs": [[...], ...]}`` (row-major nested lists; a dict of
+    ``{input_name: rows}`` for multi-input engines). Returns
+    ``{"predictions": [...], "rows": n}``. Overload returns a structured
+    ``503 {"error": {"code": "queue_full", ...}}``.
+``GET /healthz``
+    Liveness + engine stats (buckets, compile counts, request totals).
+``GET /metrics``
+    Full ``utils.metrics`` summary: counters, scalar series, and the serving
+    histograms (queue depth, batch fill ratio, padding waste, latency
+    p50/p95/p99).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .batcher import MicroBatcher, QueueFull
+
+
+class InferenceServer:
+    """Own an engine + micro-batcher and serve them over HTTP.
+
+    ``InferenceServer(engine, port=0)`` binds an ephemeral port (read it back
+    from ``server.port`` after :meth:`start` — tests depend on this). The
+    server runs on daemon threads; use as a context manager or call
+    :meth:`stop`.
+    """
+
+    def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
+                 batcher: Optional[MicroBatcher] = None,
+                 max_delay_ms: float = 2.0, max_queue: int = 1024,
+                 request_timeout_s: float = 30.0):
+        self.engine = engine
+        self.batcher = batcher if batcher is not None else MicroBatcher(
+            engine, max_delay_ms=max_delay_ms, max_queue=max_queue)
+        self.metrics = self.batcher.metrics
+        self.request_timeout_s = float(request_timeout_s)
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          self._make_handler())
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "InferenceServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="inference-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._httpd.shutdown()
+        self._thread.join(timeout=10.0)
+        self._httpd.server_close()
+        self._thread = None
+        self.batcher.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request handling ----------------------------------------------------
+
+    def _parse_inputs(self, payload: Dict[str, Any]):
+        inputs = payload.get("inputs", payload.get("instances"))
+        if inputs is None:
+            raise ValueError('body must carry "inputs" (or "instances")')
+        if getattr(self.engine, "_multi", False):
+            keys = list(getattr(self.engine, "_in_keys"))
+            if not isinstance(inputs, dict):
+                raise ValueError(
+                    f'multi-input engine: "inputs" must be an object mapping '
+                    f'input names {keys} to row lists')
+            missing = [k for k in keys if k not in inputs]
+            if missing:
+                raise ValueError(f"missing inputs: {missing}")
+            return tuple(np.asarray(inputs[k]) for k in keys)
+        if isinstance(inputs, dict):
+            raise ValueError('single-input engine: "inputs" must be a list '
+                             "of rows, not an object")
+        return np.asarray(inputs)
+
+    def _predict(self, body: bytes) -> Tuple[int, Dict[str, Any]]:
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            x = self._parse_inputs(payload)
+        except (ValueError, TypeError) as exc:
+            self.metrics.incr("serving/http_400")
+            return 400, {"error": {"code": "bad_request",
+                                   "message": str(exc)}}
+        try:
+            out = self.batcher.predict(x, timeout=self.request_timeout_s)
+        except QueueFull as exc:
+            self.metrics.incr("serving/http_503")
+            return 503, {"error": {"code": "queue_full",
+                                   "message": str(exc)}}
+        except ValueError as exc:
+            self.metrics.incr("serving/http_400")
+            return 400, {"error": {"code": "bad_request",
+                                   "message": str(exc)}}
+        except Exception as exc:  # noqa: BLE001 - surface, don't hang
+            self.metrics.incr("serving/http_500")
+            return 500, {"error": {"code": "internal",
+                                   "message": f"{type(exc).__name__}: {exc}"}}
+        self.metrics.incr("serving/http_200")
+        return 200, {"predictions": np.asarray(out).tolist(),
+                     "rows": int(np.asarray(out).shape[0])}
+
+    def _healthz(self) -> Tuple[int, Dict[str, Any]]:
+        stats = (self.engine.stats()
+                 if hasattr(self.engine, "stats") else {})
+        return 200, {"status": "ok",
+                     "queued_rows": self.batcher.depth(),
+                     "engine": stats}
+
+    def _metrics(self) -> Tuple[int, Dict[str, Any]]:
+        return 200, self.metrics.summary()
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, status: int, obj: Dict[str, Any]) -> None:
+                data = json.dumps(obj).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if self.path == "/healthz":
+                    self._reply(*server._healthz())
+                elif self.path == "/metrics":
+                    self._reply(*server._metrics())
+                else:
+                    self._reply(404, {"error": {"code": "not_found",
+                                                "message": self.path}})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/predict":
+                    self._reply(404, {"error": {"code": "not_found",
+                                                "message": self.path}})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                self._reply(*server._predict(body))
+
+            def log_message(self, fmt, *args):  # quiet: metrics cover this
+                pass
+
+        return Handler

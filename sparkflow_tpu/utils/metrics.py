@@ -1,24 +1,85 @@
 """Structured training metrics (replaces the reference's print-based logging,
 ``sparkflow/HogwildSparkModel.py:94-98`` — SURVEY.md §5 "observability").
 
-A process-local registry of counters/gauges/timings with JSONL export and an
-optional per-step callback fan-out. Cheap enough to leave on: recording is a
-dict update; device syncs only happen where the caller already has a value.
+A process-local registry of counters/gauges/timings/histograms with JSONL
+export and an optional per-step callback fan-out. Cheap enough to leave on:
+recording is a dict update; device syncs only happen where the caller already
+has a value. Histograms (``observe``/``percentile``) back the serving-side
+latency metrics (p50/p95/p99) and are bounded by a reservoir cap so a
+long-lived server never grows without limit.
 """
 
 from __future__ import annotations
 
 import json
+import random
+import threading
 import time
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+# Per-histogram sample cap. Beyond it, reservoir sampling keeps a uniform
+# sample of the whole stream (percentiles stay unbiased) instead of the
+# unbounded append a months-long serving process would otherwise pay for.
+HISTOGRAM_RESERVOIR = 4096
+
+
+class _Histogram:
+    """Reservoir-sampled value distribution with exact count/min/max/sum."""
+
+    __slots__ = ("samples", "count", "total", "vmin", "vmax", "_rng")
+
+    def __init__(self, seed: int = 0):
+        self.samples: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self._rng = random.Random(seed)
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.vmin = min(self.vmin, value)
+        self.vmax = max(self.vmax, value)
+        if len(self.samples) < HISTOGRAM_RESERVOIR:
+            self.samples.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < HISTOGRAM_RESERVOIR:
+                self.samples[j] = value
+
+    def percentile(self, q: float) -> float:
+        """Linear-interpolated q-th percentile (q in [0, 100]) of the
+        reservoir sample."""
+        if not self.samples:
+            raise ValueError("empty histogram")
+        s = sorted(self.samples)
+        if len(s) == 1:
+            return s[0]
+        pos = (q / 100.0) * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        frac = pos - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.count,
+                "mean": self.total / self.count if self.count else 0.0,
+                "min": self.vmin, "max": self.vmax,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99)}
 
 
 class Metrics:
     def __init__(self):
         self._scalars: Dict[str, List[tuple]] = defaultdict(list)
         self._counters: Dict[str, float] = defaultdict(float)
+        self._hists: Dict[str, _Histogram] = {}
         self._listeners: List[Callable[[str, float, int], None]] = []
+        # serving handlers record from many threads; scalar/counter dict
+        # updates are GIL-atomic but histogram reservoir updates are not
+        self._hist_lock = threading.Lock()
 
     def scalar(self, name: str, value: float, step: Optional[int] = None) -> None:
         step = step if step is not None else len(self._scalars[name])
@@ -29,6 +90,28 @@ class Metrics:
     def incr(self, name: str, amount: float = 1.0) -> None:
         self._counters[name] += amount
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the ``name`` histogram (latencies,
+        batch sizes, fill ratios — anything whose distribution matters more
+        than its last value)."""
+        with self._hist_lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram(seed=len(self._hists))
+            h.add(float(value))
+
+    def percentile(self, name: str, q: float) -> float:
+        """q-th percentile (q in [0, 100]) of histogram ``name``."""
+        with self._hist_lock:
+            if name not in self._hists:
+                raise KeyError(f"no histogram named {name!r}")
+            return self._hists[name].percentile(q)
+
+    def percentiles(self, name: str,
+                    qs: Sequence[float] = (50, 95, 99)) -> Dict[str, float]:
+        """{'p50': ..., 'p95': ..., 'p99': ...} for histogram ``name``."""
+        return {f"p{g:g}": self.percentile(name, g) for g in qs}
+
     def subscribe(self, fn: Callable[[str, float, int], None]) -> None:
         self._listeners.append(fn)
 
@@ -38,12 +121,20 @@ class Metrics:
     def counters(self) -> Dict[str, float]:
         return dict(self._counters)
 
+    def histograms(self) -> Dict[str, Dict[str, float]]:
+        with self._hist_lock:
+            return {name: h.summary() for name, h in self._hists.items()
+                    if h.count}
+
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"counters": self.counters()}
         for name, pts in self._scalars.items():
             vals = [v for _, v, _ in pts]
             out[name] = {"last": vals[-1], "min": min(vals), "max": max(vals),
                          "count": len(vals)}
+        hists = self.histograms()
+        if hists:
+            out["histograms"] = hists
         return out
 
     def dump_jsonl(self, path: str) -> None:
@@ -54,10 +145,14 @@ class Metrics:
                                         "value": value, "ts": ts}) + "\n")
             for name, value in self._counters.items():
                 f.write(json.dumps({"name": name, "counter": value}) + "\n")
+            for name, hist in self.histograms().items():
+                f.write(json.dumps({"name": name, "histogram": hist}) + "\n")
 
     def reset(self) -> None:
         self._scalars.clear()
         self._counters.clear()
+        with self._hist_lock:
+            self._hists.clear()
 
 
 default_metrics = Metrics()

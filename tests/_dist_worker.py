@@ -8,14 +8,25 @@ into one global array, runs a psum-backed global reduction and one
 data-parallel train step, and prints machine-checkable lines.
 """
 
+import os
 import sys
+
+# must precede the jax import: jax 0.4.x has no jax_num_cpu_devices config
+# option, so per-process virtual CPU devices can only come from XLA_FLAGS
+# (the parent test pops XLA_FLAGS from the env so the count is ours to pin)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2").strip()
 
 import jax
 
-# must precede any device use; env JAX_PLATFORMS can be overridden by
-# site customizations in some images (see tests/conftest.py)
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:  # belt and braces vs site customizations overriding env (see conftest)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+# plain CPU clients can't run cross-process collectives ("Multiprocess
+# computations aren't implemented on the CPU backend"); gloo TCP can
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

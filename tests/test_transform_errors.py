@@ -1,0 +1,96 @@
+"""SparkAsyncDLModel._transform driver-side validation.
+
+Each of these config errors is designed to fail on the DRIVER with an
+actionable message (the raise sites precede ``dataset.rdd.mapPartitions``) —
+not as an opaque task failure at action time. Previously they were validated
+only implicitly through the happy-path e2e tests.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.localml import LocalSession, Vectors
+from sparkflow_tpu.spark_async import SparkAsyncDLModel
+
+
+@pytest.fixture(scope="module")
+def spark():
+    return LocalSession.builder.getOrCreate()
+
+
+@pytest.fixture(scope="module")
+def df(spark):
+    rows = [(Vectors.dense(np.arange(4, dtype=float) + i),) for i in range(6)]
+    return spark.createDataFrame(rows, ["features"])
+
+
+def _model(**overrides):
+    def g():
+        x = nn.placeholder([None, 4], name="x")
+        h = nn.dense(x, 3, activation="relu")
+        nn.dense(h, 2, name="out")
+
+    rs = np.random.RandomState(0)
+    weights = json.dumps([rs.randn(4, 3).tolist(), rs.randn(3).tolist(),
+                          rs.randn(3, 2).tolist(), rs.randn(2).tolist()])
+    kwargs = dict(inputCol="features", modelJson=build_graph(g),
+                  modelWeights=weights, tfInput="x:0",
+                  tfOutput="out/BiasAdd:0", predictionCol="predicted")
+    kwargs.update(overrides)
+    return SparkAsyncDLModel(**kwargs)
+
+
+def test_extra_inputs_length_mismatch_rejected(df):
+    model = _model(extraInputCols="a,b", extraTfInputs="a:0")
+    with pytest.raises(ValueError,
+                       match=r"extraInputCols \(2 names\).*must pair up"):
+        model.transform(df)
+
+
+def test_bad_inference_quantize_mode_rejected(df):
+    model = _model(inferenceQuantize="int4")
+    with pytest.raises(ValueError,
+                       match="inferenceQuantize must be one of"):
+        model.transform(df)
+    # the two real modes pass validation and transform end to end
+    for mode in ("weight_only", "dynamic"):
+        out = _model(inferenceQuantize=mode).transform(df).collect()
+        assert len(out) == 6
+
+
+def test_mesh_shape_non_dp_axis_rejected(df):
+    model = _model(meshShape="tp=2")
+    with pytest.raises(ValueError,
+                       match="serves data-parallel only"):
+        model.transform(df)
+    model = _model(meshShape="dp=2,tp=2")
+    with pytest.raises(ValueError, match="not inference strategies"):
+        model.transform(df)
+
+
+def test_mesh_shape_too_many_devices_rejected(df):
+    import jax
+    need = len(jax.devices()) * 2
+    model = _model(meshShape=f"dp={need}")
+    with pytest.raises(ValueError,
+                       match=f"needs {need} devices; {len(jax.devices())} "
+                             "visible"):
+        model.transform(df)
+
+
+def test_mesh_shape_garbage_string_rejected(df):
+    with pytest.raises(ValueError, match="not 'axis=size'"):
+        _model(meshShape="dp:2").transform(df)
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        _model(meshShape="zz=2").transform(df)
+
+
+def test_valid_config_still_transforms(df):
+    # control: the same model with none of the bad configs serves fine
+    out = _model().transform(df).collect()
+    assert len(out) == 6
+    assert all(len(np.asarray(r["predicted"].toArray())) == 2 for r in out)

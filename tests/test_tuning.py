@@ -145,16 +145,26 @@ def test_cross_validator_over_dl_estimator(spark):
                        tfInput="x:0", tfLabel="y:0", labelCol="label",
                        tfOutput="out:0", iters=20, miniBatchSize=32,
                        tfOptimizer="adam", predictionCol="rawPrediction")
-    # an absurdly small lr cannot separate the data in 20 iters; a sane one can
+    # an absurdly small lr leaves the model at its random init; a sane one
+    # fits. AUC saturates at 1.0 for BOTH on data this separable (even an
+    # untrained projection ranks it), so score calibration error (rmse of the
+    # sigmoid output vs the 0/1 label) instead: the untrained model sits near
+    # 0.5 everywhere while the trained one pushes toward the labels.
     grid = ParamGridBuilder().addGrid(est.tfLearningRate,
                                       [1e-6, 5e-2]).build()
-    from sparkflow_tpu.localml import BinaryClassificationEvaluator
+    from sparkflow_tpu.localml import (BinaryClassificationEvaluator,
+                                       RegressionEvaluator)
     tvs = TrainValidationSplit(estimator=est, estimatorParamMaps=grid,
-                               evaluator=BinaryClassificationEvaluator(
-                                   labelCol="label"),
+                               evaluator=RegressionEvaluator(
+                                   predictionCol="rawPrediction",
+                                   labelCol="label", metricName="rmse"),
                                trainRatio=0.75, seed=0)
     model = tvs.fit(df)
-    assert model.validationMetrics[1] > model.validationMetrics[0]
+    # rmse: smaller is better, so the sane lr must come out LOWER and win
+    assert model.validationMetrics[1] < model.validationMetrics[0]
+    # smaller-is-better argmin picked the sane-lr model as bestModel
+    assert model.validationMetrics.index(
+        min(model.validationMetrics)) == 1
     auc = BinaryClassificationEvaluator(labelCol="label").evaluate(
         model.transform(df))
     assert auc > 0.9

@@ -106,6 +106,75 @@ def test_metrics_jsonl_dump(tmp_path):
     assert lines[0]["name"] == "a"
 
 
+def test_metrics_histogram_percentiles():
+    m = Metrics()
+    for v in range(1, 101):  # 1..100: pN is ~N at 1% granularity
+        m.observe("latency_ms", float(v))
+    assert m.percentile("latency_ms", 0) == 1.0
+    assert m.percentile("latency_ms", 100) == 100.0
+    assert m.percentile("latency_ms", 50) == pytest.approx(50.5)
+    ps = m.percentiles("latency_ms")
+    assert set(ps) == {"p50", "p95", "p99"}
+    assert ps["p95"] == pytest.approx(95.05)
+    assert ps["p99"] == pytest.approx(99.01)
+    assert ps["p50"] <= ps["p95"] <= ps["p99"]
+    h = m.histograms()["latency_ms"]
+    assert h["count"] == 100 and h["min"] == 1.0 and h["max"] == 100.0
+    assert h["mean"] == pytest.approx(50.5)
+    with pytest.raises(KeyError):
+        m.percentile("nope", 50)
+    from sparkflow_tpu.utils.metrics import _Histogram
+    with pytest.raises(ValueError):
+        _Histogram().percentile(50)  # empty histogram
+
+
+def test_metrics_histogram_reservoir_bounded():
+    from sparkflow_tpu.utils.metrics import HISTOGRAM_RESERVOIR
+    m = Metrics()
+    n = HISTOGRAM_RESERVOIR * 3
+    for v in range(n):
+        m.observe("big", float(v))
+    h = m._hists["big"]
+    assert len(h.samples) == HISTOGRAM_RESERVOIR  # memory stays bounded
+    s = m.histograms()["big"]
+    assert s["count"] == n  # exact stats survive the sampling
+    assert s["min"] == 0.0 and s["max"] == float(n - 1)
+    # reservoir-sampled median of a uniform ramp lands near the true median
+    assert abs(m.percentile("big", 50) - (n - 1) / 2) < n * 0.05
+
+
+def test_metrics_histogram_concurrent_observe():
+    m = Metrics()
+
+    def worker(k):
+        for v in range(200):
+            m.observe("shared", float(v + k))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m._hists["shared"].count == 8 * 200
+
+
+def test_metrics_histogram_in_summary_and_jsonl(tmp_path):
+    m = Metrics()
+    assert "histograms" not in m.summary()  # only present once observed
+    m.observe("h", 2.0)
+    m.observe("h", 4.0)
+    s = m.summary()
+    assert s["histograms"]["h"]["count"] == 2
+    p = str(tmp_path / "m.jsonl")
+    m.dump_jsonl(p)
+    import json
+    hist_lines = [json.loads(l) for l in open(p) if "histogram" in l]
+    assert hist_lines and hist_lines[0]["name"] == "h"
+    assert hist_lines[0]["histogram"]["mean"] == pytest.approx(3.0)
+    m.reset()
+    assert m.histograms() == {}
+
+
 def test_tracing_annotate_runs():
     import jax
     import jax.numpy as jnp
