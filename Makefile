@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -67,6 +67,11 @@ serve-smoke:
 	assert p.shape == (3, 2), p.shape; \
 	srv.stop(); \
 	print('serve-smoke OK: 3x2 prediction served at', srv.url)"
+
+# chaos suite: deterministic fault injection against checkpoints, resume,
+# coordinator joins, and serving drain (docs/resilience.md)
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:

@@ -29,6 +29,10 @@ Public surface (mirrors the reference module-for-module):
 - :mod:`sparkflow_tpu.serving`       — online inference: AOT bucket engine,
   micro-batcher, JSON-HTTP front (beyond the reference, whose only inference
   path is the offline batch transform)
+- :mod:`sparkflow_tpu.resilience`    — retry policies, crash-consistent
+  checkpoint verification, resumable-fit driver, deterministic fault
+  injection, serving drain lifecycle (the reference's failure story was
+  drop-the-update-and-print)
 """
 
 __version__ = "0.1.0"

@@ -1,10 +1,24 @@
-"""Step-level checkpoint / resume (orbax-backed).
+"""Step-level checkpoint / resume (orbax-backed), crash-consistent.
 
 The reference has save-at-end only: weights become a JSON string Param and
 optimizer state dies with the parameter-server process (SURVEY.md §5
 "Checkpoint/resume"). This module is the capability upgrade: periodic
 checkpoints of (params, opt_state, step, rng) during training, resumable
 mid-run, plus a plain-weights export for the model loader.
+
+Crash consistency (the resilience contract):
+
+- ``save`` writes the step into a temp dir, records a ``manifest.json`` with
+  a sha256 per file, then atomically renames the dir into place — a process
+  killed mid-save leaves a ``_tmp_*`` dir (invisible to ``all_steps``) and an
+  intact previous checkpoint, never a half-written ``step_<n>``.
+- ``latest.json`` is written via tmp + ``os.replace`` (the pointer can't be
+  torn), and ``latest_step`` falls back to scanning the step dirs when the
+  pointer is missing or garbled.
+- ``restore`` verifies the manifest checksums and automatically falls back
+  to the newest *valid* step when the latest is torn or corrupt (transient
+  read errors retried per ``RetryPolicy``); it raises
+  :class:`CheckpointError` only when steps exist but none restores.
 
 Sharded opt-state interop: zero1 (weight-update-sharded) fits checkpoint the
 STANDARD param-shaped opt state, not the flat sharded layout — the trainer
@@ -17,8 +31,11 @@ transparently gathers any still-device-sharded leaves it is handed.
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
+import shutil
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -32,42 +49,105 @@ except Exception:  # pragma: no cover
 
 from .graphdef import GraphModel, list_to_params, params_to_list
 
+logger = logging.getLogger("sparkflow_tpu")
+
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointError(RuntimeError):
+    """Checkpoints exist but none could be restored (all torn/corrupt)."""
+
+
+def _file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
 
 class CheckpointManager:
     """Periodic training checkpoints under one directory.
 
-    Layout: ``<dir>/step_<n>/state`` (orbax pytree) + ``<dir>/latest.json``.
-    Falls back to npz-per-leaf if orbax is unavailable.
+    Layout: ``<dir>/step_<n>/state`` (orbax pytree) + per-step
+    ``manifest.json`` + ``<dir>/latest.json``. Falls back to npz-per-leaf if
+    orbax is unavailable. ``retry`` (a
+    :class:`~sparkflow_tpu.resilience.retry.RetryPolicy`) governs transient
+    read errors during restore; the default retries OSErrors once.
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, retry=None):
         self.directory = os.path.abspath(directory)
         self.keep = keep
+        self.retry = retry
         os.makedirs(self.directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
 
+    # -- save ---------------------------------------------------------------
+
+    def _write_manifest(self, tmp: str, step: int) -> None:
+        files = {}
+        for root, _dirs, names in os.walk(tmp):
+            for nm in sorted(names):
+                full = os.path.join(root, nm)
+                rel = os.path.relpath(full, tmp)
+                files[rel] = {"sha256": _file_sha256(full),
+                              "bytes": os.path.getsize(full)}
+        manifest = {"step": int(step),
+                    "format": "orbax" if _HAVE_ORBAX else "npz",
+                    "files": files}
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+
+    def _write_latest(self, step: int) -> None:
+        # tmp + os.replace: the pointer file is swapped atomically — a kill
+        # mid-write can never leave a truncated latest.json behind
+        final = os.path.join(self.directory, "latest.json")
+        tmp = final + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"latest_step": int(step)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
     def save(self, step: int, state: Dict[str, Any]) -> None:
-        path = self._step_dir(step)
+        final = self._step_dir(step)
+        # the tmp name intentionally fails all_steps's int parse, so a crash
+        # mid-save leaves a dir no reader ever mistakes for a checkpoint
+        tmp = os.path.join(self.directory, f"_tmp_step_{step}_{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
         state = jax.tree.map(np.asarray, state)
-        if _HAVE_ORBAX:
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.join(path, "state"), state, force=True)
-        else:  # pragma: no cover
-            os.makedirs(path, exist_ok=True)
-            flat, _treedef = jax.tree.flatten(state)
-            np.savez(os.path.join(path, "state.npz"),
-                     **{f"l_{i}": x for i, x in enumerate(flat)})
-        with open(os.path.join(self.directory, "latest.json"), "w") as f:
-            json.dump({"latest_step": step}, f)
+        try:
+            if _HAVE_ORBAX:
+                ckptr = ocp.PyTreeCheckpointer()
+                ckptr.save(os.path.join(tmp, "state"), state, force=True)
+            else:  # pragma: no cover
+                os.makedirs(tmp, exist_ok=True)
+                flat, _treedef = jax.tree.flatten(state)
+                np.savez(os.path.join(tmp, "state.npz"),
+                         **{f"l_{i}": x for i, x in enumerate(flat)})
+            self._write_manifest(tmp, step)
+            from .resilience import faults as _faults
+            _faults.fire("checkpoint.pre_commit")
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)  # atomic on one filesystem
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._write_latest(step)
         self._gc()
 
     def _gc(self) -> None:
         steps = self.all_steps()
         for s in steps[:-self.keep] if self.keep > 0 else []:
-            import shutil
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # -- discovery / verification -------------------------------------------
 
     def all_steps(self) -> List[int]:
         steps = []
@@ -81,34 +161,134 @@ class CheckpointManager:
 
     def latest_step(self) -> Optional[int]:
         p = os.path.join(self.directory, "latest.json")
-        if not os.path.exists(p):
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    s = json.load(f).get("latest_step")
+                if isinstance(s, int) and os.path.isdir(self._step_dir(s)):
+                    return s
+                logger.warning(
+                    "latest.json names step %r but no such checkpoint dir "
+                    "exists; scanning %s instead", s, self.directory)
+            except (ValueError, OSError) as e:
+                logger.warning(
+                    "latest.json in %s is unreadable (%s); scanning step "
+                    "dirs instead", self.directory, e)
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def verify_step(self, step: int) -> Optional[bool]:
+        """Check ``step`` against its checksum manifest: True = every file
+        present with matching size+sha256; False = torn/corrupt; None = a
+        pre-manifest (legacy) checkpoint that cannot be verified."""
+        path = self._step_dir(step)
+        if not os.path.isdir(path):
+            return False
+        mp = os.path.join(path, MANIFEST_NAME)
+        if not os.path.exists(mp):
             return None
-        with open(p) as f:
-            return json.load(f).get("latest_step")
+        try:
+            with open(mp) as f:
+                files = json.load(f)["files"]
+        except (ValueError, KeyError, OSError):
+            return False
+        for rel, rec in files.items():
+            full = os.path.join(path, rel)
+            if not os.path.isfile(full):
+                return False
+            if os.path.getsize(full) != rec.get("bytes"):
+                return False
+            if _file_sha256(full) != rec.get("sha256"):
+                return False
+        return True
+
+    # -- restore ------------------------------------------------------------
+
+    def _read(self, step: int, like: Optional[Dict[str, Any]]):
+        path = self._step_dir(step)
+
+        def read():
+            if _HAVE_ORBAX:
+                ckptr = ocp.PyTreeCheckpointer()
+                if like is not None:
+                    template = jax.tree.map(np.asarray, like)
+                    return ckptr.restore(os.path.join(path, "state"),
+                                         item=template)
+                return ckptr.restore(os.path.join(path, "state"))
+            # npz fallback: leaves are stored flat in tree order; `like`
+            # supplies the structure
+            if like is None:  # pragma: no cover
+                raise RuntimeError(
+                    "orbax unavailable: npz restore needs `like` (a "
+                    "template pytree with the same structure)")
+            with np.load(os.path.join(path, "state.npz")) as z:  # pragma: no cover
+                flat = [z[f"l_{i}"] for i in range(len(z.files))]
+            treedef = jax.tree.structure(like)  # pragma: no cover
+            return jax.tree.unflatten(treedef, flat)  # pragma: no cover
+
+        if self.retry is None:
+            from .resilience.retry import RetryPolicy
+            policy = RetryPolicy(max_attempts=2, base_s=0.05, max_s=0.2,
+                                 retry_on=(OSError,), seed=0)
+        else:
+            policy = self.retry
+        return policy.call(read, describe=f"restore checkpoint step {step}")
 
     def restore(self, step: Optional[int] = None,
-                like: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, Any]]:
-        """Restore the state pytree at ``step`` (default: latest). ``like`` is
-        a template pytree used to restore exact structure/dtypes."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
+                like: Optional[Dict[str, Any]] = None,
+                verify: bool = True) -> Optional[Dict[str, Any]]:
+        """Restore the state pytree at ``step`` (default: latest valid).
+        ``like`` is a template pytree used to restore exact structure/dtypes.
+
+        With ``step=None``, candidates are tried newest-first: a step whose
+        manifest fails verification (or whose read raises) is skipped with a
+        warning and the next-newest is tried — automatic fallback past torn
+        or corrupt checkpoints, no manual intervention. Returns None only
+        when the directory holds no checkpoints at all; raises
+        :class:`CheckpointError` when steps exist but none restores. An
+        explicit ``step`` never falls back: corruption there raises.
+        """
+        explicit = step is not None
+        if explicit:
+            candidates = [step]
+        else:
+            candidates = sorted(self.all_steps(), reverse=True)
+            latest = self.latest_step()
+            if latest in candidates:  # pointer first (normally the max)
+                candidates.remove(latest)
+                candidates.insert(0, latest)
+        if not candidates:
             return None
-        path = self._step_dir(step)
-        if _HAVE_ORBAX:
-            ckptr = ocp.PyTreeCheckpointer()
-            if like is not None:
-                template = jax.tree.map(np.asarray, like)
-                return ckptr.restore(os.path.join(path, "state"), item=template)
-            return ckptr.restore(os.path.join(path, "state"))
-        # npz fallback: leaves are stored flat in tree order; `like` supplies
-        # the structure (pragma: orbax is present in the supported image)
-        if like is None:  # pragma: no cover
-            raise RuntimeError("orbax unavailable: npz restore needs `like` "
-                               "(a template pytree with the same structure)")
-        with np.load(os.path.join(path, "state.npz")) as z:  # pragma: no cover
-            flat = [z[f"l_{i}"] for i in range(len(z.files))]
-        treedef = jax.tree.structure(like)  # pragma: no cover
-        return jax.tree.unflatten(treedef, flat)  # pragma: no cover
+        failures = []
+        for s in candidates:
+            if verify and self.verify_step(s) is False:
+                if explicit:
+                    raise CheckpointError(
+                        f"checkpoint step {s} in {self.directory} fails its "
+                        f"manifest checksum (torn or corrupt)")
+                logger.warning(
+                    "checkpoint step %d fails its manifest checksum (torn "
+                    "or corrupt); falling back to the next valid step", s)
+                failures.append((s, "manifest checksum mismatch"))
+                continue
+            try:
+                state = self._read(s, like)
+            except Exception as e:
+                if explicit:
+                    raise
+                logger.warning(
+                    "checkpoint step %d is unreadable (%s: %s); falling "
+                    "back to the next valid step", s, type(e).__name__, e)
+                failures.append((s, f"{type(e).__name__}: {e}"))
+                continue
+            if failures:
+                logger.warning(
+                    "restored checkpoint step %d after skipping corrupt "
+                    "step(s) %s", s, [f[0] for f in failures])
+            return state
+        detail = "; ".join(f"step {s}: {why}" for s, why in failures)
+        raise CheckpointError(
+            f"no restorable checkpoint in {self.directory} ({detail})")
 
     # -- plain-weights interop (model_loader) -------------------------------
 
@@ -120,13 +300,14 @@ class CheckpointManager:
                  **{f"w_{i}": w for i, w in enumerate(weights)})
 
     @staticmethod
-    def load_weights(directory: str, model: GraphModel) -> List[np.ndarray]:
+    def load_weights(directory: str, model: GraphModel,
+                     retry=None) -> List[np.ndarray]:
         p = os.path.join(directory, "weights.npz")
         if os.path.exists(p):
             with np.load(p) as z:
                 return [z[k] for k in sorted(z.files, key=lambda s: int(s.split("_")[-1]))]
         # orbax training checkpoint: pull params out of the latest state
-        mgr = CheckpointManager(directory)
+        mgr = CheckpointManager(directory, retry=retry)
         state = mgr.restore()
         if state is None or "params" not in state:
             raise FileNotFoundError(f"no weights.npz or checkpoints in {directory}")

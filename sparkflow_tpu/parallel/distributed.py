@@ -21,6 +21,7 @@ driver or any job scheduler):
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 from typing import Dict, Optional, Sequence
@@ -30,6 +31,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import make_mesh
+
+logger = logging.getLogger("sparkflow_tpu")
 
 _INITIALIZED = False
 
@@ -45,10 +48,25 @@ def determine_master(port: int = 8476) -> str:
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               retries: Optional[int] = None,
+               retry_policy=None) -> None:
     """Join the global JAX process group. On TPU pods all arguments are
     discovered from the TPU metadata; elsewhere pass them (or set
-    SPARKFLOW_TPU_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID)."""
+    SPARKFLOW_TPU_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    Join resilience (pod restarts rarely bring every host up at once):
+    ``timeout_s`` bounds each join attempt (forwarded as JAX's
+    ``initialization_timeout``; env ``SPARKFLOW_TPU_COORD_TIMEOUT_S``), and
+    ``retries`` re-attempts a failed join that many extra times with
+    exponential backoff (env ``SPARKFLOW_TPU_COORD_RETRIES``, default 0 —
+    single attempt, original exception). Pass a
+    :class:`~sparkflow_tpu.resilience.retry.RetryPolicy` as ``retry_policy``
+    to shape the backoff; a spent budget raises
+    :class:`~sparkflow_tpu.resilience.retry.RetryExhausted` naming the
+    coordinator address.
+    """
     global _INITIALIZED
     if _INITIALIZED:
         return
@@ -68,21 +86,48 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["process_id"] = int(process_id)
     elif os.environ.get("JAX_PROCESS_ID"):
         kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    if timeout_s is None and os.environ.get("SPARKFLOW_TPU_COORD_TIMEOUT_S"):
+        timeout_s = float(os.environ["SPARKFLOW_TPU_COORD_TIMEOUT_S"])
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = int(timeout_s)
+    if retries is None and os.environ.get("SPARKFLOW_TPU_COORD_RETRIES"):
+        retries = int(os.environ["SPARKFLOW_TPU_COORD_RETRIES"])
     hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     multi_host = len(hosts) > 1
     if not (kwargs or multi_host):
         # nothing to do (single host, no explicit coordination args) — do NOT
         # latch, so a later call WITH explicit args still forms the group
         return
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:
-        if "more than once" in str(e):
-            pass  # a prior component already formed the group
-        else:
-            # e.g. backends were initialized before initialize() — that is
-            # a real misconfiguration on a pod; surface it
-            raise
+
+    def attempt():
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as e:
+            if "more than once" in str(e):
+                pass  # a prior component already formed the group
+            else:
+                # e.g. backends were initialized before initialize() — that
+                # is a real misconfiguration on a pod; surface it
+                raise
+
+    if retry_policy is None and not retries:
+        attempt()  # single shot: the original exception propagates untouched
+        _INITIALIZED = True
+        return
+    from ..resilience.retry import RetryPolicy
+    policy = retry_policy or RetryPolicy(
+        max_attempts=int(retries) + 1, base_s=1.0, multiplier=2.0,
+        max_s=30.0, jitter=0.5, seed=0)
+    coord = kwargs.get("coordinator_address", "<tpu-metadata-discovered>")
+
+    def _log_retry(n, delay, err):
+        logger.warning(
+            "join attempt %d at coordinator %s failed (%s: %s); retrying "
+            "in %.1fs", n, coord, type(err).__name__, err, delay)
+
+    policy.call(attempt,
+                describe=f"join JAX process group at coordinator {coord}",
+                on_retry=_log_retry)
     _INITIALIZED = True
 
 

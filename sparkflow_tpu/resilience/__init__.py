@@ -1,0 +1,34 @@
+"""Resilience layer: survive crashes, preemption, and corruption.
+
+The reference's whole failure story was drop-the-update-and-print
+(SURVEY.md §5); the north star — preemptible TPU pods serving production
+traffic — demands the opposite. Where DeepSpark/SparkNet lean on Spark's
+task-retry semantics, this repo replaced Spark executors with a JAX process
+group, so the recovery machinery lives here instead:
+
+- :mod:`~sparkflow_tpu.resilience.retry` — :class:`RetryPolicy`
+  (exponential backoff + jitter + deadline) and the structured
+  :class:`RetryExhausted`; reused by coordinator joins
+  (``parallel.distributed.initialize``), checkpoint restore, the serving
+  client, and the resilient-fit driver.
+- :mod:`~sparkflow_tpu.resilience.driver` — :func:`run_resilient_fit`:
+  re-invoke ``Trainer.fit`` after crashes/preemptions, resuming from the
+  newest *valid* checkpoint to bit-identical final weights.
+- :mod:`~sparkflow_tpu.resilience.faults` — deterministic chaos harness:
+  named fault points (:func:`~faults.inject`/:func:`~faults.fire`),
+  crash/SIGTERM loss_callback injectors, on-disk checkpoint corruption.
+- :mod:`~sparkflow_tpu.resilience.lifecycle` — the SERVING/DRAINING state
+  machine behind the HTTP front's graceful drain.
+
+Crash-consistent checkpointing itself (tmp-dir + checksum manifest + atomic
+rename, restore fallback to the newest valid step) lives in
+:mod:`sparkflow_tpu.checkpoint`. See ``docs/resilience.md``.
+"""
+
+from .driver import run_resilient_fit
+from .lifecycle import Lifecycle, ServerState
+from .retry import RetryExhausted, RetryPolicy
+from . import faults
+
+__all__ = ["RetryPolicy", "RetryExhausted", "run_resilient_fit",
+           "Lifecycle", "ServerState", "faults"]

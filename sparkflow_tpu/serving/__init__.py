@@ -20,14 +20,19 @@ users") requires:
 - :class:`~sparkflow_tpu.serving.server.InferenceServer` /
   :class:`~sparkflow_tpu.serving.client.ServingClient` — a stdlib JSON-HTTP
   front (``/v1/predict``, ``/healthz``, ``/metrics``) and its tiny client.
+  The server carries the ``resilience.lifecycle`` state machine: SIGTERM (or
+  ``drain()``) finishes in-flight requests while new ones get ``503`` +
+  ``Retry-After`` (:class:`~sparkflow_tpu.serving.batcher.Draining`), and
+  the client retries 503s/connection errors with jittered backoff.
 
-See ``docs/serving.md`` and ``examples/serving_example.py``.
+See ``docs/serving.md``, ``docs/resilience.md``, and
+``examples/serving_example.py``.
 """
 
-from .batcher import MicroBatcher, QueueFull
+from .batcher import Draining, MicroBatcher, QueueFull
 from .client import ServingClient, ServingError
 from .engine import InferenceEngine
 from .server import InferenceServer
 
-__all__ = ["InferenceEngine", "MicroBatcher", "QueueFull",
+__all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
            "InferenceServer", "ServingClient", "ServingError"]

@@ -31,6 +31,13 @@ class QueueFull(Exception):
     capacity — the caller should shed the request (HTTP 503), not wait."""
 
 
+class Draining(QueueFull):
+    """Raised by :meth:`MicroBatcher.submit` once :meth:`begin_drain` was
+    called: queued work still completes, but no new work is admitted. The
+    HTTP front maps this to ``503`` + ``Retry-After`` so a load balancer
+    re-routes instead of surfacing an error."""
+
+
 class _Pending:
     __slots__ = ("rows", "future", "enqueued_at")
 
@@ -77,7 +84,9 @@ class MicroBatcher:
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Pending] = []
         self._queued_rows = 0
+        self._inflight_rows = 0  # rows popped into the batch being served
         self._closed = False
+        self._draining = False
         self._worker = threading.Thread(target=self._loop,
                                         name="microbatcher", daemon=True)
         self._worker.start()
@@ -98,6 +107,10 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if self._draining:
+                self.metrics.incr("serving/drain_rejections")
+                raise Draining("MicroBatcher is draining; in-flight work "
+                               "completes but new requests are refused")
             if self._queued_rows + n > self.max_queue:
                 self.metrics.incr("serving/queue_rejections")
                 raise QueueFull(
@@ -113,6 +126,30 @@ class MicroBatcher:
     def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
         """Blocking convenience wrapper: ``submit(x).result(timeout)``."""
         return self.submit(x).result(timeout)
+
+    def begin_drain(self) -> None:
+        """Stop admitting work (submits raise :class:`Draining`) while the
+        worker finishes everything already queued. Idempotent; pair with
+        :meth:`wait_drained`, then :meth:`close`."""
+        with self._cond:
+            if self._closed or self._draining:
+                return
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout: Optional[float] = 10.0) -> bool:
+        """Block until no request is queued or being served. Returns False
+        if ``timeout`` expired with work still in flight."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while self._pending or self._inflight_rows:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
 
     def close(self, drain: bool = True, timeout: float = 10.0) -> None:
         """Stop the worker. With ``drain`` (default) queued requests are
@@ -166,11 +203,11 @@ class MicroBatcher:
                 self._cond.wait()
             if not self._pending:
                 return None  # closed and drained
-            if self.max_delay_ms > 0:
+            if self.max_delay_ms > 0 and not self._draining:
                 oldest = self._pending[0].enqueued_at
                 deadline = oldest + self.max_delay_ms / 1000.0
                 while (self._queued_rows < self.max_batch
-                       and not self._closed):
+                       and not self._closed and not self._draining):
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
@@ -184,6 +221,7 @@ class MicroBatcher:
                 batch.append(p)
                 rows += n
             self._queued_rows -= rows
+            self._inflight_rows += rows
             return batch
 
     def _loop(self) -> None:
@@ -191,7 +229,13 @@ class MicroBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
-            self._serve(batch)
+            try:
+                self._serve(batch)
+            finally:
+                with self._cond:
+                    self._inflight_rows -= sum(p.rows[0].shape[0]
+                                               for p in batch)
+                    self._cond.notify_all()  # wait_drained watches this
 
     def _serve(self, batch: List[_Pending]) -> None:
         sizes = [p.rows[0].shape[0] for p in batch]

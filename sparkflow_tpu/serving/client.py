@@ -4,6 +4,12 @@ Deliberately tiny — ``urllib.request`` plus JSON — because its jobs are the
 smoke path (``make serve-smoke``), the e2e tests, and showing the wire
 protocol in ~30 lines. Production callers can speak the same JSON from any
 HTTP stack.
+
+Resilience: :meth:`ServingClient.predict` retries connection errors and
+``503`` rejections (queue-full backpressure, drains during a rolling
+restart) with jittered exponential backoff, honoring the server's
+``Retry-After`` hint and a hard wall-clock deadline. ``retries=0`` opts a
+call out entirely (first error propagates untouched).
 """
 
 from __future__ import annotations
@@ -15,23 +21,42 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..resilience.retry import RetryExhausted, RetryPolicy
+
 
 class ServingError(Exception):
-    """Non-2xx reply from the server. Carries the structured error body."""
+    """Non-2xx reply from the server. Carries the structured error body and,
+    when the server sent one, the ``Retry-After`` hint (seconds)."""
 
-    def __init__(self, status: int, code: str, message: str):
+    def __init__(self, status: int, code: str, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status} [{code}]: {message}")
         self.status = status
         self.code = code
         self.message = message
+        self.retry_after = retry_after
 
 
 class ServingClient:
-    """``ServingClient(url).predict(rows)`` → np.ndarray of predictions."""
+    """``ServingClient(url).predict(rows)`` → np.ndarray of predictions.
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    ``retries`` is the default number of re-attempts after a retryable
+    failure (connection refused/reset, HTTP 503); ``retry_policy`` (a
+    :class:`~sparkflow_tpu.resilience.retry.RetryPolicy`) shapes the backoff
+    and supplies the optional ``deadline_s`` — the default policy backs off
+    0.1s/0.2s/0.4s... (jittered) with no deadline. A spent budget raises
+    :class:`~sparkflow_tpu.resilience.retry.RetryExhausted` chained to the
+    last error.
+    """
+
+    def __init__(self, url: str, timeout: float = 30.0, retries: int = 3,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retries = int(retries)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=self.retries + 1, base_s=0.1, multiplier=2.0,
+            max_s=5.0, jitter=0.5, seed=0)
 
     def _request(self, path: str, payload: Optional[Dict[str, Any]] = None
                  ) -> Dict[str, Any]:
@@ -45,23 +70,63 @@ class ServingClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as exc:
+            ra = exc.headers.get("Retry-After") if exc.headers else None
+            try:
+                retry_after = float(ra) if ra is not None else None
+            except ValueError:
+                retry_after = None
             try:
                 err = json.loads(exc.read().decode("utf-8"))["error"]
                 raise ServingError(exc.code, err.get("code", "unknown"),
-                                   err.get("message", "")) from None
+                                   err.get("message", ""),
+                                   retry_after) from None
             except (ValueError, KeyError):
-                raise ServingError(exc.code, "unknown", str(exc)) from None
+                raise ServingError(exc.code, "unknown", str(exc),
+                                   retry_after) from None
 
-    def predict(self, inputs) -> np.ndarray:
+    @staticmethod
+    def _retryable(exc: Exception) -> bool:
+        if isinstance(exc, ServingError):
+            return exc.status == 503  # queue_full / draining backpressure
+        # URLError covers connection refused/reset and socket timeouts
+        return isinstance(exc, urllib.error.URLError)
+
+    def predict(self, inputs, retries: Optional[int] = None) -> np.ndarray:
         """``inputs``: rows (list/array) or, for multi-input engines, a dict
-        of ``{input_name: rows}``. Raises :class:`ServingError` on rejection
-        (e.g. ``code == 'queue_full'`` under overload)."""
+        of ``{input_name: rows}``. Retryable failures (connection errors,
+        503) back off and re-send up to ``retries`` times (default: the
+        client's setting; 0 = fail fast); anything else — 400s, 500s —
+        raises :class:`ServingError` immediately."""
         if isinstance(inputs, dict):
             wire: Any = {k: np.asarray(v).tolist() for k, v in inputs.items()}
         else:
             wire = np.asarray(inputs).tolist()
-        reply = self._request("/v1/predict", {"inputs": wire})
-        return np.asarray(reply["predictions"])
+        payload = {"inputs": wire}
+        budget = (self.retries if retries is None else int(retries)) + 1
+        policy = self.retry_policy
+        start = policy.clock()
+        attempt = 0
+        while True:
+            try:
+                reply = self._request("/v1/predict", payload)
+                return np.asarray(reply["predictions"])
+            except (ServingError, urllib.error.URLError) as e:
+                attempt += 1
+                if not self._retryable(e) or attempt >= budget:
+                    raise
+                delay = policy.backoff(attempt - 1)
+                hint = getattr(e, "retry_after", None)
+                if hint is not None:
+                    # the server knows its own drain/queue horizon better
+                    # than our backoff curve does
+                    delay = max(delay, float(hint))
+                elapsed = policy.clock() - start
+                if (policy.deadline_s is not None
+                        and elapsed + delay > policy.deadline_s):
+                    raise RetryExhausted(
+                        f"predict against {self.url}", attempt, elapsed,
+                        e) from e
+                policy.sleep(delay)
 
     def healthz(self) -> Dict[str, Any]:
         return self._request("/healthz")

@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import _sharded_trace_guard
+from ..resilience import faults
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
 
@@ -140,15 +141,21 @@ class InferenceEngine:
     # -- loading -------------------------------------------------------------
 
     @classmethod
-    def from_checkpoint(cls, directory: str, graph, **kwargs
+    def from_checkpoint(cls, directory: str, graph, retry=None, **kwargs
                         ) -> "InferenceEngine":
         """Load from a :class:`~sparkflow_tpu.checkpoint.CheckpointManager`
-        directory (``weights.npz`` export or an orbax training checkpoint)."""
+        directory (``weights.npz`` export or an orbax training checkpoint,
+        whose restore verifies manifest checksums and falls back past
+        corrupt steps). ``retry`` (a
+        :class:`~sparkflow_tpu.resilience.retry.RetryPolicy`) governs
+        transient read errors — network filesystems at replica-start time
+        are exactly the flaky window it exists for."""
         from ..checkpoint import CheckpointManager
         from ..models import model_from_json
         model = (model_from_json(graph, kwargs.get("compute_dtype"))
                  if isinstance(graph, str) else graph)
-        weights = CheckpointManager.load_weights(directory, model)
+        weights = CheckpointManager.load_weights(directory, model,
+                                                 retry=retry)
         return cls(model, weights, **kwargs)
 
     def _load_params(self, weights):
@@ -261,6 +268,7 @@ class InferenceEngine:
         """Predict for ``x``: one array ``[n, ...]`` (or a tuple for
         multi-input models), any ``n >= 1``. Pads to the nearest bucket;
         requests beyond ``max_batch`` run in max_batch chunks."""
+        faults.fire("engine.predict")  # chaos hook; no-op unless armed
         xs = tuple(np.asarray(a) for a in x) if self._multi \
             else (np.asarray(x),)
         if xs[0].ndim == len(self._in_shapes[0]):  # single unbatched row

@@ -16,7 +16,9 @@ Endpoints
     ``{"predictions": [...], "rows": n}``. Overload returns a structured
     ``503 {"error": {"code": "queue_full", ...}}``.
 ``GET /healthz``
-    Liveness + engine stats (buckets, compile counts, request totals).
+    Liveness + engine stats (buckets, compile counts, request totals) and
+    the lifecycle state; flips to ``503`` once the server is draining so
+    load balancers eject the replica before its socket goes away.
 ``GET /metrics``
     Full ``utils.metrics`` summary: counters, scalar series, and the serving
     histograms (queue depth, batch fill ratio, padding waste, latency
@@ -26,13 +28,18 @@ Endpoints
 from __future__ import annotations
 
 import json
+import logging
+import signal as signal_mod
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from .batcher import MicroBatcher, QueueFull
+from ..resilience.lifecycle import Lifecycle, ServerState
+from .batcher import Draining, MicroBatcher, QueueFull
+
+logger = logging.getLogger("sparkflow_tpu")
 
 
 class InferenceServer:
@@ -42,23 +49,35 @@ class InferenceServer:
     from ``server.port`` after :meth:`start` — tests depend on this). The
     server runs on daemon threads; use as a context manager or call
     :meth:`stop`.
+
+    Lifecycle (``resilience.lifecycle``): ``STARTING -> SERVING`` on
+    :meth:`start`; :meth:`drain` (or a SIGTERM via
+    :meth:`install_signal_handlers`) moves to ``DRAINING`` — in-flight
+    requests finish, new ones get ``503`` + ``Retry-After`` — and
+    :meth:`stop` drains first, then tears the socket down (``STOPPED``).
     """
 
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
                  batcher: Optional[MicroBatcher] = None,
                  max_delay_ms: float = 2.0, max_queue: int = 1024,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 drain_timeout_s: float = 10.0,
+                 retry_after_s: float = 1.0):
         self.engine = engine
         self.batcher = batcher if batcher is not None else MicroBatcher(
             engine, max_delay_ms=max_delay_ms, max_queue=max_queue)
         self.metrics = self.batcher.metrics
         self.request_timeout_s = float(request_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.retry_after_s = float(retry_after_s)
+        self.lifecycle = Lifecycle()
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._prev_handlers: Dict[int, Any] = {}
 
     @property
     def url(self) -> str:
@@ -70,16 +89,64 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="inference-server", daemon=True)
         self._thread.start()
+        self.lifecycle.transition(ServerState.SERVING)
         return self
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install_signal_handlers(self,
+                                signals=(signal_mod.SIGTERM,)) -> bool:
+        """Arm graceful drain on SIGTERM (preemption notice): the handler
+        kicks :meth:`drain` off on a background thread and returns, so the
+        grace window is spent finishing in-flight work, not blocking the
+        handler. Main-thread only (CPython signal routing); returns whether
+        handlers were installed. :meth:`stop` restores the previous
+        handlers."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def on_signal(signum, frame):
+            logger.warning("signal %d received: draining the inference "
+                           "server", signum)
+            threading.Thread(target=self.drain, name="serving-drain",
+                             daemon=True).start()
+
+        for s in signals:
+            self._prev_handlers[s] = signal_mod.signal(s, on_signal)
+        return True
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting requests (503 + ``Retry-After``),
+        finish everything in flight, leave the socket up so health checks
+        can observe the draining state. Idempotent. Returns True when the
+        server went fully idle inside ``timeout`` (default
+        ``drain_timeout_s``)."""
+        timeout = self.drain_timeout_s if timeout is None else timeout
+        self.lifecycle.transition(ServerState.DRAINING)
+        self.batcher.begin_drain()
+        idle = self.lifecycle.wait_idle(timeout)
+        drained = self.batcher.wait_drained(timeout)
+        if not (idle and drained):
+            logger.warning(
+                "drain timed out after %.1fs with work still in flight "
+                "(inflight_http=%d)", timeout, self.lifecycle.inflight)
+        return idle and drained
 
     def stop(self) -> None:
         if self._thread is None:
             return
+        self.drain()
         self._httpd.shutdown()
         self._thread.join(timeout=10.0)
         self._httpd.server_close()
         self._thread = None
         self.batcher.close()
+        self.lifecycle.transition(ServerState.STOPPED)
+        if (self._prev_handlers
+                and threading.current_thread() is threading.main_thread()):
+            for s, prev in self._prev_handlers.items():
+                signal_mod.signal(s, prev)
+            self._prev_handlers.clear()
 
     def __enter__(self):
         return self.start()
@@ -109,7 +176,7 @@ class InferenceServer:
                              "of rows, not an object")
         return np.asarray(inputs)
 
-    def _predict(self, body: bytes) -> Tuple[int, Dict[str, Any]]:
+    def _predict(self, body: bytes) -> Tuple:  # (status, body[, headers])
         try:
             payload = json.loads(body or b"{}")
             if not isinstance(payload, dict):
@@ -121,10 +188,16 @@ class InferenceServer:
                                    "message": str(exc)}}
         try:
             out = self.batcher.predict(x, timeout=self.request_timeout_s)
+        except Draining as exc:
+            # the drain began after this request was admitted; shed it the
+            # same way un-admitted ones are shed
+            self.metrics.incr("serving/http_503")
+            return 503, {"error": {"code": "draining",
+                                   "message": str(exc)}}, self._retry_after()
         except QueueFull as exc:
             self.metrics.incr("serving/http_503")
             return 503, {"error": {"code": "queue_full",
-                                   "message": str(exc)}}
+                                   "message": str(exc)}}, self._retry_after()
         except ValueError as exc:
             self.metrics.incr("serving/http_400")
             return 400, {"error": {"code": "bad_request",
@@ -137,12 +210,25 @@ class InferenceServer:
         return 200, {"predictions": np.asarray(out).tolist(),
                      "rows": int(np.asarray(out).shape[0])}
 
-    def _healthz(self) -> Tuple[int, Dict[str, Any]]:
+    def _retry_after(self) -> Dict[str, str]:
+        return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
+
+    def _healthz(self) -> Tuple[int, Dict[str, Any], Optional[Dict[str, str]]]:
         stats = (self.engine.stats()
                  if hasattr(self.engine, "stats") else {})
-        return 200, {"status": "ok",
-                     "queued_rows": self.batcher.depth(),
-                     "engine": stats}
+        state = self.lifecycle.state
+        body = {"status": ("ok" if state in (ServerState.SERVING,
+                                             ServerState.STARTING)
+                           else state.value),
+                "state": state.value,
+                "inflight": self.lifecycle.inflight,
+                "queued_rows": self.batcher.depth(),
+                "engine": stats}
+        if state in (ServerState.SERVING, ServerState.STARTING):
+            return 200, body, None
+        # draining/stopped: flip readiness so the load balancer ejects this
+        # replica before its socket goes away
+        return 503, body, self._retry_after()
 
     def _metrics(self) -> Tuple[int, Dict[str, Any]]:
         return 200, self.metrics.summary()
@@ -153,11 +239,14 @@ class InferenceServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def _reply(self, status: int, obj: Dict[str, Any]) -> None:
+            def _reply(self, status: int, obj: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 data = json.dumps(obj).encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -175,9 +264,22 @@ class InferenceServer:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
                     return
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                self._reply(*server._predict(body))
+                # admission control: a draining/stopped server sheds the
+                # request BEFORE reading work into the batcher, with a
+                # Retry-After hint for the balancer's re-dispatch
+                if not server.lifecycle.try_begin_request():
+                    server.metrics.incr("serving/http_503")
+                    self._reply(503, {"error": {
+                        "code": "draining",
+                        "message": "server is draining; retry on another "
+                                   "replica"}}, server._retry_after())
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    self._reply(*server._predict(body))
+                finally:
+                    server.lifecycle.end_request()
 
             def log_message(self, fmt, *args):  # quiet: metrics cover this
                 pass

@@ -69,15 +69,29 @@ def _ckpt_state(params, opt_state, step, rng, *, rng_impl):
 
 
 class TrainResult:
-    """Outcome of a fit: final params + per-epoch mean losses."""
+    """Outcome of a fit: final params + per-epoch mean losses.
 
-    __slots__ = ("params", "losses", "examples_per_sec", "wall_time_s")
+    ``stop_reason`` says how the fit ended — ``'completed'`` (ran every
+    planned epoch), ``'preempted'`` (SIGTERM checkpoint-and-return; resuming
+    on the same checkpoint_dir finishes the run), or ``'nan'``
+    (halt_on_nan tripped). ``resilience.run_resilient_fit`` keys its restart
+    decision off this field.
+    """
 
-    def __init__(self, params, losses, examples_per_sec, wall_time_s):
+    __slots__ = ("params", "losses", "examples_per_sec", "wall_time_s",
+                 "stop_reason")
+
+    def __init__(self, params, losses, examples_per_sec, wall_time_s,
+                 stop_reason: str = "completed"):
         self.params = params
         self.losses = losses
         self.examples_per_sec = examples_per_sec
         self.wall_time_s = wall_time_s
+        self.stop_reason = stop_reason
+
+    @property
+    def completed(self) -> bool:
+        return self.stop_reason == "completed"
 
 
 class Trainer:
@@ -934,7 +948,10 @@ class Trainer:
         epoch_losses = [float(loss_by_it[k]) for k in epoch_keys]
         if not nan_halted:  # the halt already logged its own ERROR
             self._warn_non_finite(epoch_losses, epoch_keys)
-        return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall)
+        stop = ("nan" if nan_halted
+                else "preempted" if preempted else "completed")
+        return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall,
+                           stop_reason=stop)
 
     def ema_weights(self):
         """The debiased Polyak-averaged weight tree from the last fit, when
@@ -1197,7 +1214,10 @@ class Trainer:
         step_losses = [float(l) for l in losses]
         if not nan_halted:  # the halt already logged its own ERROR
             self._warn_non_finite(step_losses)
-        return TrainResult(params, step_losses, seen / max(wall, 1e-9), wall)
+        stop = ("nan" if nan_halted
+                else "preempted" if stream_guard.requested else "completed")
+        return TrainResult(params, step_losses, seen / max(wall, 1e-9), wall,
+                           stop_reason=stop)
 
     # -- conveniences -------------------------------------------------------
 

@@ -25,16 +25,22 @@ class PreemptionGuard:
     a flag instead of killing the process. Main-thread only (CPython routes
     signals to the main thread); elsewhere it degrades to a no-op guard."""
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    def __init__(self, signals=(signal.SIGTERM,), on_signal=None):
         self._signals = tuple(signals)
         self._previous = {}
         self.requested = False
         self._armed = False
+        # optional hook fired from the handler after the latch is set —
+        # serving uses it to flip its lifecycle to DRAINING; must itself be
+        # async-signal-tolerant (no locks the interrupted thread may hold)
+        self._on_signal = on_signal
 
     def _handler(self, signum, frame):
         self.requested = True
         logger.warning("signal %d received: will checkpoint and stop at the "
                        "next epoch boundary", signum)
+        if self._on_signal is not None:
+            self._on_signal(signum)
 
     def __enter__(self):
         if threading.current_thread() is threading.main_thread():

@@ -1,0 +1,586 @@
+"""Resilience layer: retry policy, fault injection, crash-consistent
+checkpoints, bit-identical resume, serving drain, client retries."""
+
+import os
+import signal
+import threading
+import time
+import urllib.error
+
+import jax
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.checkpoint import CheckpointError, CheckpointManager
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.resilience import (RetryExhausted, RetryPolicy, faults,
+                                      run_resilient_fit)
+from sparkflow_tpu.resilience.lifecycle import Lifecycle, ServerState
+from sparkflow_tpu.trainer import Trainer
+
+
+# -- retry policy (stubbed clock/sleep: no real waiting) ---------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, d):
+        self.t += d
+
+
+def test_retry_succeeds_after_transient_failures():
+    clock = _Clock()
+    pol = RetryPolicy(max_attempts=5, base_s=1.0, multiplier=2.0, max_s=100.0,
+                      jitter=0.0, sleep=clock.sleep, clock=clock)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert calls["n"] == 4
+    assert clock.t == pytest.approx(1.0 + 2.0 + 4.0)  # exponential, no jitter
+
+
+def test_retry_exhausted_is_structured():
+    clock = _Clock()
+    pol = RetryPolicy(max_attempts=3, base_s=0.5, jitter=0.0,
+                      sleep=clock.sleep, clock=clock)
+
+    def always():
+        raise ValueError("boom")
+
+    with pytest.raises(RetryExhausted) as ei:
+        pol.call(always, describe="doomed op")
+    e = ei.value
+    assert e.op == "doomed op" and e.attempts == 3
+    assert isinstance(e.last_error, ValueError)
+    assert isinstance(e.__cause__, ValueError)
+    assert "doomed op" in str(e) and "boom" in str(e)
+
+
+def test_retry_deadline_cuts_attempts_short():
+    clock = _Clock()
+    pol = RetryPolicy(max_attempts=100, base_s=10.0, max_s=100.0, jitter=0.0,
+                      deadline_s=5.0, sleep=clock.sleep, clock=clock)
+    with pytest.raises(RetryExhausted) as ei:
+        pol.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert ei.value.attempts == 1  # first backoff (10s) would bust 5s budget
+
+
+def test_retry_non_retryable_propagates_untouched():
+    pol = RetryPolicy(max_attempts=5, retry_on=(OSError,),
+                      sleep=lambda d: None)
+    with pytest.raises(KeyError):
+        pol.call(lambda: (_ for _ in ()).throw(KeyError("nope")))
+
+
+def test_retry_jitter_is_seeded_and_bounded():
+    a = [RetryPolicy(base_s=1.0, jitter=0.5, seed=7).backoff(0)
+         for _ in range(3)]
+    b = [RetryPolicy(base_s=1.0, jitter=0.5, seed=7).backoff(0)
+         for _ in range(3)]
+    assert a == b  # reproducible
+    for d in a:
+        assert 0.5 <= d <= 1.5
+
+
+# -- fault points ------------------------------------------------------------
+
+def test_fire_is_noop_when_unarmed():
+    faults.fire("nonexistent.point")  # must not raise
+
+
+def test_inject_fails_chosen_calls_and_counts():
+    with faults.inject("p.x", fail_calls=[1]) as spec:
+        faults.fire("p.x")
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p.x")
+        faults.fire("p.x")
+        assert spec.calls == 3 and spec.failures == 1
+    faults.fire("p.x")  # disarmed on exit
+
+
+def test_inject_max_failures_lets_retries_win():
+    with faults.inject("p.y", p_fail=1.0, max_failures=2) as spec:
+        pol = RetryPolicy(max_attempts=5, base_s=0.0, jitter=0.0,
+                          sleep=lambda d: None)
+        pol.call(lambda: faults.fire("p.y"))
+        assert spec.failures == 2 and spec.calls == 3
+
+
+def test_inject_refuses_double_arming():
+    with faults.inject("p.z"):
+        with pytest.raises(RuntimeError):
+            with faults.inject("p.z"):
+                pass
+
+
+# -- crash-consistent checkpoints -------------------------------------------
+
+def _state(v=0.0):
+    return {"params": {"w": np.full((4, 3), v, np.float32)},
+            "step": np.int64(1)}
+
+
+def test_save_is_atomic_under_pre_commit_crash(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    with faults.inject("checkpoint.pre_commit", fail_calls=[0]):
+        with pytest.raises(faults.InjectedFault):
+            mgr.save(2, _state(2.0))
+    # the torn save left no step dir, no tmp litter, and a usable step 1
+    assert mgr.all_steps() == [1]
+    assert not [n for n in os.listdir(tmp_path) if n.startswith("_tmp")]
+    assert mgr.latest_step() == 1
+    r = mgr.restore()
+    assert np.all(np.asarray(r["params"]["w"]) == 1.0)
+
+
+def test_latest_json_garbled_falls_back_to_scan(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state())
+    mgr.save(2, _state())
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="latest_json")
+    assert mgr.latest_step() == 2
+    # missing entirely is also fine
+    os.remove(tmp_path / "latest.json")
+    assert mgr.latest_step() == 2
+
+
+def test_manifest_catches_corruption_and_restore_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    step, _path = faults.corrupt_latest_checkpoint(str(tmp_path), mode="flip")
+    assert step == 2
+    assert mgr.verify_step(2) is False and mgr.verify_step(1) is True
+    r = mgr.restore()  # falls back past the corrupt step automatically
+    assert np.all(np.asarray(r["params"]["w"]) == 1.0)
+
+
+def test_truncation_and_manifest_garbling_detected(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="truncate")
+    assert mgr.verify_step(2) is False
+    mgr.save(3, _state(3.0))
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="manifest")
+    assert mgr.verify_step(3) is False
+    r = mgr.restore()
+    assert np.all(np.asarray(r["params"]["w"]) == 1.0)
+
+
+def test_all_corrupt_raises_checkpoint_error(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state())
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="flip")
+    with pytest.raises(CheckpointError):
+        mgr.restore()
+
+
+def test_explicit_step_never_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="flip")
+    with pytest.raises(CheckpointError):
+        mgr.restore(step=2)
+    r = mgr.restore(step=1)
+    assert np.all(np.asarray(r["params"]["w"]) == 1.0)
+
+
+def test_legacy_dir_without_manifest_is_accepted(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(5.0))
+    os.remove(tmp_path / "step_1" / "manifest.json")
+    assert mgr.verify_step(1) is None  # unverifiable, not invalid
+    r = mgr.restore()
+    assert np.all(np.asarray(r["params"]["w"]) == 5.0)
+
+
+def test_empty_directory_restores_none(tmp_path):
+    assert CheckpointManager(str(tmp_path)).restore() is None
+
+
+# -- bit-identical resume ----------------------------------------------------
+
+def _reg_graph():
+    x = nn.placeholder([None, 6], name="x")
+    y = nn.placeholder([None, 1], name="y")
+    h = nn.dense(x, 8, activation="relu")
+    o = nn.dense(h, 1, name="out")
+    nn.mean_squared_error(y, o)
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rs = np.random.RandomState(0)
+    X = rs.randn(97, 6).astype(np.float32)
+    Y = (X @ rs.randn(6))[:, None].astype(np.float32)
+    return X, Y
+
+
+def _trainer(ckdir, cb=None, retries=0):
+    return Trainer(build_graph(_reg_graph), "x:0", "y:0", iters=8,
+                   mini_batch_size=32, checkpoint_dir=ckdir,
+                   checkpoint_every=2, seed=3, loss_callback=cb,
+                   resume_retries=retries)
+
+
+def _leaves(params):
+    return np.concatenate([np.ravel(np.asarray(l))
+                           for l in jax.tree.leaves(params)])
+
+
+@pytest.fixture(scope="module")
+def baseline(reg_data, tmp_path_factory):
+    X, Y = reg_data
+    d = tmp_path_factory.mktemp("base")
+    # loss_callback keeps the loop path so trajectories match injected runs
+    return _trainer(str(d), cb=lambda *a: None).fit(X, Y)
+
+
+def test_crash_then_resilient_fit_is_bit_identical(reg_data, baseline,
+                                                   tmp_path):
+    X, Y = reg_data
+    crash = faults.crash_at(5)  # epoch 5 raises once; latest checkpoint is 4
+    res = run_resilient_fit(_trainer(str(tmp_path), cb=crash), X, Y,
+                            max_restarts=2)
+    assert crash.fired == 1
+    assert res.stop_reason == "completed" and res.completed
+    # same rng stream + optimizer state across the restart: exact equality
+    assert np.array_equal(_leaves(baseline.params), _leaves(res.params))
+    assert res.losses == baseline.losses[-len(res.losses):]
+
+
+def test_in_fit_retry_budget_is_bit_identical(reg_data, baseline, tmp_path):
+    X, Y = reg_data
+    crash = faults.crash_at(5)
+    res = _trainer(str(tmp_path), cb=crash, retries=2).fit(X, Y)
+    assert crash.fired == 1 and res.completed
+    assert np.array_equal(_leaves(baseline.params), _leaves(res.params))
+
+
+def test_sigterm_preempts_then_resumes_bit_identical(reg_data, baseline,
+                                                     tmp_path):
+    X, Y = reg_data
+    tr = _trainer(str(tmp_path), cb=faults.sigterm_at(3))
+    first = tr.fit(X, Y)
+    assert first.stop_reason == "preempted" and not first.completed
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == 3  # saved at the preemption point
+    second = tr.fit(X, Y)  # injector is spent (times=1): runs to the end
+    assert second.completed
+    assert np.array_equal(_leaves(baseline.params), _leaves(second.params))
+
+
+def test_resume_survives_corrupted_latest_checkpoint(reg_data, baseline,
+                                                     tmp_path):
+    X, Y = reg_data
+    tr = _trainer(str(tmp_path), cb=faults.sigterm_at(5))
+    tr.fit(X, Y)  # preempted at 5; checkpoints 2, 4, 5 on disk
+    faults.corrupt_latest_checkpoint(str(tmp_path), mode="flip")
+    # restore skips the torn step 5, resumes from 4, re-runs 5..8 — and the
+    # deterministic trajectory still lands on the exact baseline weights
+    res = tr.fit(X, Y)
+    assert res.completed
+    assert np.array_equal(_leaves(baseline.params), _leaves(res.params))
+
+
+def test_driver_refuses_without_checkpoint_dir(reg_data):
+    X, Y = reg_data
+    tr = Trainer(build_graph(_reg_graph), "x:0", "y:0", iters=2,
+                 mini_batch_size=32)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        run_resilient_fit(tr, X, Y)
+
+
+def test_driver_exhausts_restart_budget(reg_data, tmp_path):
+    X, Y = reg_data
+    forever = faults.crash_at(3, times=99)  # re-fires on every resume
+    pol = RetryPolicy(max_attempts=2, base_s=0.0, jitter=0.0, seed=0,
+                      sleep=lambda d: None)
+    with pytest.raises(RetryExhausted) as ei:
+        run_resilient_fit(_trainer(str(tmp_path), cb=forever), X, Y,
+                          max_restarts=1, restart_policy=pol)
+    assert isinstance(ei.value.last_error, faults.InjectedFault)
+
+
+# -- serving lifecycle -------------------------------------------------------
+
+def test_lifecycle_edges_and_inflight():
+    lc = Lifecycle()
+    assert lc.state is ServerState.STARTING
+    assert not lc.try_begin_request()  # not serving yet
+    assert lc.transition(ServerState.SERVING)
+    assert lc.try_begin_request() and lc.inflight == 1
+    assert lc.transition(ServerState.DRAINING)
+    assert not lc.try_begin_request()  # draining admits nothing
+    assert not lc.transition(ServerState.SERVING)  # no un-drain edge
+    assert not lc.transition(ServerState.DRAINING)  # repeat is a no-op
+    assert not lc.wait_idle(timeout=0.05)  # one request still in flight
+    lc.end_request()
+    assert lc.wait_idle(timeout=1.0) and lc.inflight == 0
+    assert lc.transition(ServerState.STOPPED)
+    assert not lc.transition(ServerState.SERVING)
+
+
+@pytest.fixture(scope="module")
+def serving_engine():
+    from sparkflow_tpu.serving import InferenceEngine
+
+    def g():
+        x = nn.placeholder([None, 4], name="x")
+        nn.dense(x, 2, name="out")
+
+    rs = np.random.RandomState(0)
+    w = [rs.randn(4, 2).astype(np.float32), rs.randn(2).astype(np.float32)]
+    return InferenceEngine(build_graph(g), w, input_name="x:0",
+                           output_name="out/BiasAdd:0", max_batch=8)
+
+
+def test_drain_finishes_inflight_and_sheds_new(serving_engine):
+    from sparkflow_tpu.serving import (InferenceServer, ServingClient,
+                                       ServingError)
+    srv = InferenceServer(serving_engine, max_delay_ms=0.0).start()
+    try:
+        cli = ServingClient(srv.url, retries=0)
+        assert cli.healthz()["state"] == "serving"
+        with faults.inject("engine.predict", delay_ms=300):
+            got = {}
+
+            def slow():
+                got["out"] = cli.predict(np.zeros((2, 4)).tolist())
+
+            t = threading.Thread(target=slow)
+            t.start()
+            time.sleep(0.1)  # let it into the batcher
+            dr = threading.Thread(target=srv.drain)
+            dr.start()
+            time.sleep(0.1)
+            with pytest.raises(ServingError) as ei:
+                ServingClient(srv.url, retries=0).predict(
+                    np.zeros((1, 4)).tolist())
+            assert ei.value.status == 503 and ei.value.code == "draining"
+            assert ei.value.retry_after is not None  # Retry-After honored
+            t.join(timeout=5)
+            dr.join(timeout=5)
+            assert got["out"].shape == (2, 2)  # in-flight request completed
+        assert srv.lifecycle.state is ServerState.DRAINING
+        with pytest.raises(ServingError) as ei:
+            cli.healthz()  # readiness flips so balancers eject the replica
+        assert ei.value.status == 503
+    finally:
+        srv.stop()
+    assert srv.lifecycle.state is ServerState.STOPPED
+
+
+def test_sigterm_triggers_graceful_drain(serving_engine):
+    from sparkflow_tpu.serving import InferenceServer, ServingClient
+    prev = signal.getsignal(signal.SIGTERM)
+    srv = InferenceServer(serving_engine).start()
+    try:
+        assert srv.install_signal_handlers()
+        cli = ServingClient(srv.url, retries=0)
+        assert cli.predict(np.zeros((1, 4)).tolist()).shape == (1, 2)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while (srv.lifecycle.state is ServerState.SERVING
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert srv.lifecycle.state is ServerState.DRAINING
+    finally:
+        srv.stop()
+    # stop() restored the previous SIGTERM disposition
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_batcher_drain_unit(serving_engine):
+    from sparkflow_tpu.serving import Draining, MicroBatcher
+    b = MicroBatcher(serving_engine, max_delay_ms=0.0, max_queue=64)
+    try:
+        fut = b.submit(np.zeros((2, 4), np.float32))
+        assert fut.result(timeout=5).shape == (2, 2)
+        b.begin_drain()
+        with pytest.raises(Draining):
+            b.submit(np.zeros((1, 4), np.float32))
+        assert b.wait_drained(timeout=5)
+    finally:
+        b.close()
+
+
+# -- serving client retries (stubbed transport: no sockets, no sleeping) -----
+
+def _stub_policy(sleeps):
+    return RetryPolicy(max_attempts=10, base_s=0.1, multiplier=2.0,
+                       jitter=0.0, seed=0, sleep=sleeps.append)
+
+
+def test_client_retries_503_until_success(monkeypatch):
+    from sparkflow_tpu.serving.client import ServingClient, ServingError
+    calls = {"n": 0}
+
+    def fake(self, path, payload=None):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ServingError(503, "queue_full", "busy")
+        return {"predictions": [[1.0, 2.0]]}
+
+    monkeypatch.setattr(ServingClient, "_request", fake)
+    sleeps = []
+    c = ServingClient("http://stub", retries=3,
+                      retry_policy=_stub_policy(sleeps))
+    out = c.predict([[0.0]])
+    assert out.shape == (1, 2) and calls["n"] == 3
+    assert sleeps == [0.1, 0.2]  # exponential, jitter off
+
+
+def test_client_honors_retry_after_hint(monkeypatch):
+    from sparkflow_tpu.serving.client import ServingClient, ServingError
+    calls = {"n": 0}
+
+    def fake(self, path, payload=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ServingError(503, "draining", "drain", retry_after=2.5)
+        return {"predictions": [[0.0]]}
+
+    monkeypatch.setattr(ServingClient, "_request", fake)
+    sleeps = []
+    c = ServingClient("http://stub", retries=2,
+                      retry_policy=_stub_policy(sleeps))
+    c.predict([[0.0]])
+    assert sleeps == [2.5]  # server hint overrides the smaller backoff
+
+
+def test_client_retries_connection_errors(monkeypatch):
+    from sparkflow_tpu.serving.client import ServingClient
+    calls = {"n": 0}
+
+    def fake(self, path, payload=None):
+        calls["n"] += 1
+        raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+
+    monkeypatch.setattr(ServingClient, "_request", fake)
+    c = ServingClient("http://stub", retries=2,
+                      retry_policy=_stub_policy([]))
+    with pytest.raises(urllib.error.URLError):
+        c.predict([[0.0]])
+    assert calls["n"] == 3  # initial + 2 retries, then the error surfaces
+
+
+def test_client_retries_zero_opts_out_and_4xx_never_retries(monkeypatch):
+    from sparkflow_tpu.serving.client import ServingClient, ServingError
+    calls = {"n": 0}
+
+    def fake(self, path, payload=None):
+        calls["n"] += 1
+        raise ServingError(503 if calls["n"] == 1 else 400, "x", "y")
+
+    monkeypatch.setattr(ServingClient, "_request", fake)
+    c = ServingClient("http://stub", retries=0)
+    with pytest.raises(ServingError):
+        c.predict([[0.0]])
+    assert calls["n"] == 1  # retries=0: fail fast
+    calls["n"] = 1  # next call raises 400
+    c2 = ServingClient("http://stub", retries=5,
+                       retry_policy=_stub_policy([]))
+    with pytest.raises(ServingError) as ei:
+        c2.predict([[0.0]])
+    assert ei.value.status == 400 and calls["n"] == 2  # no retry on 4xx
+
+
+def test_client_deadline_raises_retry_exhausted(monkeypatch):
+    from sparkflow_tpu.serving.client import ServingClient, ServingError
+    monkeypatch.setattr(
+        ServingClient, "_request",
+        lambda self, path, payload=None: (_ for _ in ()).throw(
+            ServingError(503, "queue_full", "busy")))
+    pol = RetryPolicy(max_attempts=10, base_s=1.0, jitter=0.0,
+                      deadline_s=0.5, sleep=lambda d: None)
+    c = ServingClient("http://stub", retry_policy=pol)
+    with pytest.raises(RetryExhausted):
+        c.predict([[0.0]])
+
+
+# -- coordinator join retry --------------------------------------------------
+
+def test_initialize_retries_join_until_success(monkeypatch):
+    from sparkflow_tpu.parallel import distributed as dist
+    calls = []
+
+    def fake_init(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    pol = RetryPolicy(max_attempts=5, base_s=0.0, jitter=0.0,
+                      sleep=lambda d: None)
+    dist.initialize(coordinator_address="10.0.0.1:8476", num_processes=1,
+                    process_id=0, timeout_s=7, retry_policy=pol)
+    assert len(calls) == 3 and dist._INITIALIZED
+    assert calls[0]["initialization_timeout"] == 7
+
+
+def test_initialize_retry_exhaustion_names_coordinator(monkeypatch):
+    from sparkflow_tpu.parallel import distributed as dist
+
+    def fake_init(**kw):
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    pol = RetryPolicy(max_attempts=2, base_s=0.0, jitter=0.0,
+                      sleep=lambda d: None)
+    with pytest.raises(RetryExhausted) as ei:
+        dist.initialize(coordinator_address="10.0.0.9:1234", num_processes=2,
+                        process_id=0, retry_policy=pol)
+    assert "10.0.0.9:1234" in str(ei.value)
+    assert not dist._INITIALIZED
+
+
+def test_initialize_single_attempt_keeps_original_error(monkeypatch):
+    from sparkflow_tpu.parallel import distributed as dist
+
+    def fake_init(**kw):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    with pytest.raises(RuntimeError, match="boom"):  # not RetryExhausted
+        dist.initialize(coordinator_address="10.0.0.1:8476", num_processes=1,
+                        process_id=0)
+
+
+def test_initialize_env_vars_drive_timeout_and_retries(monkeypatch):
+    from sparkflow_tpu.parallel import distributed as dist
+    calls = []
+
+    def fake_init(**kw):
+        calls.append(kw)
+        if len(calls) < 2:
+            raise RuntimeError("not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    monkeypatch.setenv("SPARKFLOW_TPU_COORD_TIMEOUT_S", "11")
+    monkeypatch.setenv("SPARKFLOW_TPU_COORD_RETRIES", "3")
+    # env-driven retries build the default policy (base 1s); one transient
+    # failure costs a single jittered backoff, so the test stays fast
+    dist.initialize(coordinator_address="10.0.0.1:8476",
+                    num_processes=1, process_id=0)
+    assert len(calls) == 2
+    assert calls[0]["initialization_timeout"] == 11
+    assert dist._INITIALIZED

@@ -326,7 +326,9 @@ def test_http_queue_full_is_structured_503(engine):
             return {}
 
     with InferenceServer(SlowEngine(), max_delay_ms=0.0, max_queue=2) as srv:
-        client = ServingClient(srv.url)
+        # retries=0: the client's default 503 backoff would absorb the
+        # rejection this test exists to observe
+        client = ServingClient(srv.url, retries=0)
         codes = []
 
         def hit():
