@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft
 
 test:
 	python -m pytest tests/ -q
@@ -72,6 +72,11 @@ serve-smoke:
 # coordinator joins, and serving drain (docs/resilience.md)
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+# graftcheck: sharding / tracing / concurrency lint over the repo's own
+# source + the jaxpr self-check over presets x optimizers (docs/analysis.md)
+lint-graft:
+	JAX_PLATFORMS=cpu python -m sparkflow_tpu.analysis sparkflow_tpu examples
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:

@@ -1,0 +1,52 @@
+"""graftcheck: static + trace analysis for sharding, tracing, and
+concurrency correctness (docs/analysis.md).
+
+Three analyzers behind one CLI (``python -m sparkflow_tpu.analysis``) and
+this library API:
+
+- :mod:`~sparkflow_tpu.analysis.jaxpr_lint` — abstract-traces a model or
+  train step (``jax.make_jaxpr``/``eval_shape``) against a mesh +
+  PartitionSpecs: implicit reshards, large replicated tensors, f64/weak-
+  type promotion, missed donation. Nothing executes or compiles.
+- :mod:`~sparkflow_tpu.analysis.ast_lint` +
+  :mod:`~sparkflow_tpu.analysis.locks` — source rules, no imports of the
+  scanned code: host syncs and Python branching inside jit'd functions,
+  PRNG key reuse, unhashable static args, and shared-state mutation
+  outside the owning class's lock.
+- :mod:`~sparkflow_tpu.analysis.runtime_guards` —
+  :class:`RecompileGuard` / :func:`track_recompiles`: count jit retraces
+  live and name which argument's shape/dtype/static value changed.
+
+The repo keeps itself clean under the full pass: ``make lint-graft`` (and
+``tests/test_analysis.py``) runs it over ``sparkflow_tpu/`` and
+``examples/`` and asserts zero findings.
+"""
+
+from __future__ import annotations
+
+from .findings import Finding, RULES, format_findings
+from .runtime_guards import (RecompileGuard, describe_signature_diff,
+                             trace_probe, track_recompiles)
+
+__all__ = [
+    "Finding", "RULES", "format_findings",
+    "RecompileGuard", "track_recompiles", "trace_probe",
+    "describe_signature_diff",
+    "run_static", "run_all",
+    "lint_fn", "lint_train_step", "lint_apply",
+    "ast_lint", "locks", "jaxpr_lint", "runtime_guards",
+]
+
+
+def __getattr__(name):
+    # lazy: jaxpr_lint pulls in models/optimizers; the static passes and
+    # the CLI must stay usable without importing any of that until needed
+    import importlib
+    if name in ("lint_fn", "lint_train_step", "lint_apply"):
+        return getattr(importlib.import_module(".jaxpr_lint", __name__),
+                       name)
+    if name in ("run_static", "run_all"):
+        return getattr(importlib.import_module(".cli", __name__), name)
+    if name in ("ast_lint", "locks", "jaxpr_lint", "runtime_guards"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

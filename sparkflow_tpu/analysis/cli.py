@@ -1,0 +1,82 @@
+"""graftcheck CLI: ``python -m sparkflow_tpu.analysis [paths...]``.
+
+Runs the static passes (ast_lint + lock coverage) over every ``.py`` file
+under the given paths, plus — unless ``--no-trace`` — the jaxpr self-check
+over the repo's model presets and optimizer registry. Exit status is the
+finding count clamped to 1, so CI can gate on it; ``--format json`` emits
+machine-readable findings for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from . import ast_lint, locks
+from .findings import RULES, Finding, format_findings
+
+__all__ = ["main", "run_static", "run_all"]
+
+
+def run_static(paths: Sequence[str]) -> List[Finding]:
+    """ast_lint + lock coverage over every .py under ``paths``."""
+    return ast_lint.lint_paths(paths) + locks.lint_paths(paths)
+
+
+def run_all(paths: Sequence[str], trace: bool = True,
+            ignore: Sequence[str] = ()) -> List[Finding]:
+    """The full graftcheck pass: static rules over ``paths`` and, with
+    ``trace``, the jaxpr repo self-check (model presets x optimizers)."""
+    ignore = set(ignore)
+    findings = [f for f in run_static(paths) if f.rule not in ignore]
+    if trace:
+        from . import jaxpr_lint
+        findings.extend(jaxpr_lint.repo_self_check(ignore=ignore))
+    return findings
+
+
+def _list_rules() -> str:
+    lines = ["graftcheck rule catalog (docs/analysis.md has the long form):"]
+    for rule_id in sorted(RULES):
+        name, desc = RULES[rule_id]
+        lines.append(f"  {rule_id}  {name:<24} {desc}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparkflow_tpu.analysis",
+        description="graftcheck: sharding / tracing / concurrency lint "
+                    "for sparkflow-tpu code")
+    parser.add_argument("paths", nargs="*", default=["sparkflow_tpu"],
+                        help="files or directories to lint "
+                             "(default: sparkflow_tpu)")
+    parser.add_argument("--no-trace", action="store_true",
+                        help="skip the jaxpr self-check over the repo's "
+                             "model presets and optimizer registry")
+    parser.add_argument("--ignore", default="",
+                        help="comma-separated rule ids to drop "
+                             "(e.g. GC-A203,GC-L302)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    ignore = [r.strip() for r in args.ignore.split(",") if r.strip()]
+    findings = run_all(args.paths, trace=not args.no_trace, ignore=ignore)
+
+    if args.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    elif findings:
+        print(format_findings(findings))
+        print(f"\ngraftcheck: {len(findings)} finding(s)")
+    else:
+        print("graftcheck: clean")
+    return 1 if findings else 0

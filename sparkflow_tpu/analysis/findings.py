@@ -1,0 +1,147 @@
+"""Finding/rule plumbing shared by every graftcheck analyzer.
+
+A *finding* is one (rule, location, message) triple; analyzers return lists
+of them and never print or raise — rendering and exit codes are the CLI's
+job, so the library API stays embeddable (tests assert on findings directly).
+
+Rule IDs are stable and documented in ``docs/analysis.md``; suppression is
+per-line (``# graftcheck: disable=GC-A201`` — trailing comment on the
+flagged line) or per-file (``# graftcheck: disable-file=GC-A201,GC-L302``
+anywhere in the first ten lines). Static analyzers resolve suppressions
+against the scanned source; trace-level analyzers (jaxpr/runtime) have no
+source line to hang a comment on and instead take ``ignore=`` rule sets.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "RULES", "filter_suppressed", "format_findings",
+           "parse_suppressions"]
+
+
+#: rule id -> (short name, one-line description). The single source of truth
+#: for what graftcheck checks; docs/analysis.md renders this catalog.
+RULES: Dict[str, Tuple[str, str]] = {
+    # jaxpr_lint (GC-J1xx): abstract-trace analysis against a mesh
+    "GC-J101": ("implicit-reshard",
+                "a sharding constraint silently reshards a tensor away from "
+                "its declared PartitionSpec (an all-to-all on the hot path)"),
+    "GC-J102": ("large-replicated",
+                "a large tensor is replicated on a multi-device mesh where "
+                "a sharded PartitionSpec would cut per-device memory"),
+    "GC-J103": ("f64-promotion",
+                "a float32 program produces float64 intermediates under "
+                "x64 tracing — a Python/numpy scalar promotes the hot path"),
+    "GC-J104": ("weak-type-output",
+                "a traced output is weakly typed: a bare Python scalar "
+                "dominates the result and its dtype depends on callers"),
+    "GC-J105": ("missed-donation",
+                "an input buffer matches the outputs aval-for-aval but is "
+                "not donated — XLA must double-buffer it"),
+    # ast_lint (GC-A2xx): source rules over jit'd/traced functions
+    "GC-A201": ("host-sync-in-jit",
+                "a host-synchronizing call (.item()/float()/np.asarray/"
+                "print) inside a traced function"),
+    "GC-A202": ("traced-branch",
+                "Python if/while on a traced argument — data-dependent "
+                "control flow fails or silently bakes in one branch"),
+    "GC-A203": ("prng-key-reuse",
+                "the same PRNG key is consumed by two sampling calls "
+                "without an intervening split"),
+    "GC-A204": ("unhashable-static",
+                "an argument marked static for jit defaults to an "
+                "unhashable value (list/dict/set) — every call fails "
+                "or retraces"),
+    # lock coverage (GC-L3xx): shared-state rules over lock-owning classes
+    "GC-L301": ("unlocked-guarded-write",
+                "an attribute that is written under this class's lock "
+                "elsewhere is written without it here"),
+    "GC-L302": ("unlocked-rmw",
+                "a read-modify-write (+=, -=, ...) on shared state in a "
+                "lock-owning class runs outside any lock"),
+    # runtime guards (GC-R4xx)
+    "GC-R401": ("excess-retrace",
+                "a guarded function retraced beyond its budget; the "
+                "signature diff names the argument that changed"),
+}
+
+
+@dataclass
+class Finding:
+    """One analyzer hit. ``path``/``line`` are None for trace-level findings
+    (they point at a traced callable, not a source location)."""
+
+    rule: str
+    message: str
+    path: Optional[str] = None
+    line: Optional[int] = None
+    source: str = "graftcheck"  # which analyzer produced it
+    detail: Dict[str, object] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}; known: "
+                             f"{sorted(RULES)}")
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule][0]
+
+    def location(self) -> str:
+        if self.path is None:
+            return self.source
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def render(self) -> str:
+        return f"{self.location()}: {self.rule} ({self.name}): {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "name": self.name, "path": self.path,
+                "line": self.line, "source": self.source,
+                "message": self.message, **({"detail": self.detail}
+                                            if self.detail else {})}
+
+
+_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable(-file)?\s*=\s*"
+                          r"([A-Za-z0-9_,\-\s]+)")
+
+
+def parse_suppressions(source: str) -> Tuple[set, Dict[int, set]]:
+    """(file-wide rule set, {line -> rule set}) from suppression comments.
+    ``disable-file`` is honored only in the first ten lines so a stray
+    comment deep in a module can't silently blind the whole file."""
+    file_wide: set = set()
+    per_line: Dict[int, set] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1):  # disable-file
+            if lineno <= 10:
+                file_wide |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+    return file_wide, per_line
+
+
+def filter_suppressed(findings: Sequence[Finding], source: str
+                      ) -> List[Finding]:
+    """Drop findings a suppression comment covers (matched on rule id and
+    the finding's line)."""
+    file_wide, per_line = parse_suppressions(source)
+    out = []
+    for f in findings:
+        if f.rule in file_wide:
+            continue
+        if f.line is not None and f.rule in per_line.get(f.line, ()):
+            continue
+        out.append(f)
+    return out
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)

@@ -1,0 +1,396 @@
+"""Graph-level lint (GC-J1xx): abstract-trace a program, report what will
+hurt on hardware — before it burns TPU hours.
+
+Everything here runs on :func:`jax.make_jaxpr` / :func:`jax.eval_shape`
+machinery: the model function is traced with ``ShapeDtypeStruct`` inputs,
+so no FLOP executes, no buffer is allocated, and no compile happens — a
+full lint of the repo's model presets against every registry optimizer is
+sub-second on CPU. The analysis is Parallax-style "ahead of execution":
+placement and dtype mistakes are graph properties, visible in the jaxpr
+without running it.
+
+Rules
+-----
+GC-J101  implicit-reshard   a ``sharding_constraint`` eqn pins a tensor to
+                            a different PartitionSpec than its declared
+                            input spec — GSPMD will insert a resharding
+                            collective on every step.
+GC-J102  large-replicated   an input leaf above ``large_bytes`` declared
+                            replicated (``P()``) on a >1-device mesh.
+GC-J103  f64-promotion      re-tracing under x64 turns a float32 program
+                            partially float64: a Python/numpy double made
+                            it into the graph. Such programs are one
+                            ``jax_enable_x64`` flip away from running at
+                            half speed and double memory.
+GC-J104  weak-type-output   a top-level output is weakly typed — a bare
+                            scalar literal dominates it, so its dtype is
+                            decided by the caller, not the model.
+GC-J105  missed-donation    a large input whose avals all reappear in the
+                            outputs is not donated; XLA must keep input
+                            and output buffers live simultaneously.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .findings import Finding
+
+__all__ = ["lint_fn", "lint_train_step", "repo_self_check"]
+
+#: below this, replication / double-buffering is noise, not a finding
+DEFAULT_LARGE_BYTES = 1 << 20
+
+
+def _norm_spec(spec) -> Tuple:
+    """PartitionSpec/NamedSharding -> canonical tuple (trailing Nones
+    stripped, so P('dp') == P('dp', None))."""
+    if spec is None:
+        return ()
+    if hasattr(spec, "spec"):  # NamedSharding
+        spec = spec.spec
+    parts = tuple(spec)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return parts
+
+
+def _sub_jaxprs(value) -> Iterable:
+    if isinstance(value, jax.core.ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, jax.core.Jaxpr):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def _iter_eqns(jaxpr) -> Iterable:
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _aval_bytes(aval) -> int:
+    return int(np.prod(aval.shape, dtype=np.int64)) * np.dtype(aval.dtype).itemsize
+
+
+def _leaf_paths(tree) -> List[Tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _flat_specs(arg, spec) -> List[Optional[Tuple]]:
+    """Per-leaf normalized specs for one argument pytree. ``spec`` may be
+    None (unknown), one PartitionSpec (broadcast), or a matching pytree."""
+    n = len(jax.tree.leaves(arg))
+    if spec is None:
+        return [None] * n
+    if isinstance(spec, P) or hasattr(spec, "spec"):
+        return [_norm_spec(spec)] * n
+    leaves = jax.tree.leaves(spec, is_leaf=lambda x: isinstance(x, P))
+    if len(leaves) != n:
+        raise ValueError(
+            f"in_specs entry has {len(leaves)} leaves for an argument "
+            f"with {n}; pass one PartitionSpec or a matching pytree")
+    return [_norm_spec(s) for s in leaves]
+
+
+def _struct_like(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), np.dtype(x.dtype))
+    return x
+
+
+def lint_fn(fn: Callable, args: Sequence, *,
+            in_specs: Optional[Sequence] = None,
+            mesh=None,
+            donate_argnums: Sequence[int] = (),
+            name: Optional[str] = None,
+            large_bytes: int = DEFAULT_LARGE_BYTES,
+            check_x64: bool = True,
+            ignore: Sequence[str] = ()) -> List[Finding]:
+    """Lint one traceable function.
+
+    Parameters
+    ----------
+    fn, args : the callable and its positional arguments — pytrees of
+        arrays / ``ShapeDtypeStruct``. Traced abstractly; never executed.
+    in_specs : per-argument declared placements (aligned with ``args``);
+        each entry is None (unknown), a single ``PartitionSpec``, or a
+        pytree of specs. Enables GC-J101/GC-J102.
+    mesh : the mesh the specs refer to; replication findings only fire on
+        a >1-device mesh.
+    donate_argnums : argument indices the caller's jit donates — consumed
+        by the GC-J105 check, exactly jit's convention.
+    check_x64 : re-trace under ``jax.experimental.enable_x64`` for the
+        GC-J103 promotion check (skipped automatically if any input is
+        already 64-bit).
+    """
+    ignore = set(ignore)
+    label = name or getattr(fn, "__name__", "fn")
+    args = tuple(jax.tree.map(_struct_like, a) for a in args)
+    closed = jax.make_jaxpr(fn)(*args)
+    jaxpr = closed.jaxpr
+    findings: List[Finding] = []
+
+    flat_leaves: List[Tuple[int, str, Any]] = []  # (argnum, path, leaf)
+    for i, a in enumerate(args):
+        for path, leaf in _leaf_paths(a):
+            flat_leaves.append((i, path, leaf))
+    flat_specs: List[Optional[Tuple]] = []
+    for i, a in enumerate(args):
+        spec = in_specs[i] if in_specs is not None else None
+        flat_specs.extend(_flat_specs(a, spec))
+
+    # GC-J101: sharding constraints that disagree with declared placement
+    if "GC-J101" not in ignore and in_specs is not None:
+        var_spec: Dict[Any, Tuple] = {}
+        for var, spec in zip(jaxpr.invars, flat_specs):
+            if spec is not None:
+                var_spec[var] = spec
+        for eqn in jaxpr.eqns:  # top-level only: invar identity is lost
+            if eqn.primitive.name != "sharding_constraint":  # in sub-jaxprs
+                continue
+            operand = eqn.invars[0]
+            new = _norm_spec(eqn.params.get("sharding"))
+            old = var_spec.get(operand)
+            if old is not None and old != new:
+                findings.append(Finding(
+                    "GC-J101",
+                    f"{label}: tensor {operand.aval.str_short()} declared "
+                    f"P{old} is constrained to P{new} — GSPMD reshards it "
+                    f"(a collective) every call; align the constraint or "
+                    f"the input sharding",
+                    source="jaxpr_lint",
+                    detail={"declared": old, "constrained": new}))
+            for outvar in eqn.outvars:
+                var_spec[outvar] = new
+
+    # GC-J102: large replicated inputs on a real mesh
+    if ("GC-J102" not in ignore and in_specs is not None
+            and mesh is not None and getattr(mesh, "size", 1) > 1):
+        for (argnum, path, leaf), spec in zip(flat_leaves, flat_specs):
+            if spec != () or spec is None:
+                continue
+            nbytes = _aval_bytes(leaf)
+            if nbytes >= large_bytes:
+                findings.append(Finding(
+                    "GC-J102",
+                    f"{label}: input arg{argnum}{path} "
+                    f"({tuple(leaf.shape)} {np.dtype(leaf.dtype).name}, "
+                    f"{nbytes >> 20} MiB) is replicated over {mesh.size} "
+                    f"devices — shard it or accept {mesh.size}x the HBM",
+                    source="jaxpr_lint",
+                    detail={"bytes": nbytes, "arg": argnum, "path": path}))
+
+    # GC-J103: float64 appearing under x64 in an f32 program
+    input_f64 = any(np.dtype(leaf.dtype) in (np.float64, np.complex128)
+                    for _, _, leaf in flat_leaves)
+    if "GC-J103" not in ignore and check_x64 and not input_f64:
+        try:
+            from jax.experimental import enable_x64
+            with enable_x64():
+                closed64 = jax.make_jaxpr(fn)(*args)
+        except Exception:
+            closed64 = None  # fn untraceable under x64: nothing to report
+        if closed64 is not None:
+            hits: List[str] = []
+            for eqn in _iter_eqns(closed64.jaxpr):
+                for var in eqn.outvars:
+                    aval = getattr(var, "aval", None)
+                    if aval is not None and getattr(aval, "dtype", None) is not None \
+                            and np.dtype(aval.dtype) == np.float64:
+                        hits.append(f"{eqn.primitive.name} -> "
+                                    f"{aval.str_short()}")
+                        break
+            if hits:
+                shown = "; ".join(hits[:3])
+                more = f" (+{len(hits) - 3} more)" if len(hits) > 3 else ""
+                findings.append(Finding(
+                    "GC-J103",
+                    f"{label}: float32 inputs produce float64 under x64 "
+                    f"tracing — a Python/numpy double is on the hot path: "
+                    f"{shown}{more}. Pin literals with jnp/np.float32",
+                    source="jaxpr_lint", detail={"count": len(hits)}))
+
+    # GC-J104: weakly-typed top-level outputs
+    if "GC-J104" not in ignore:
+        for idx, aval in enumerate(closed.out_avals):
+            if getattr(aval, "weak_type", False):
+                findings.append(Finding(
+                    "GC-J104",
+                    f"{label}: output {idx} ({aval.str_short()}) is weakly "
+                    f"typed — a bare Python scalar dominates it and its "
+                    f"final dtype depends on the caller; anchor it with an "
+                    f"explicit dtype",
+                    source="jaxpr_lint", detail={"output": idx}))
+
+    # GC-J105: donation opportunities
+    if "GC-J105" not in ignore:
+        donate = set(donate_argnums)
+        out_avals = [(tuple(a.shape), np.dtype(a.dtype))
+                     for a in closed.out_avals]
+        for i, a in enumerate(args):
+            if i in donate:
+                continue
+            leaves = jax.tree.leaves(a)
+            if not leaves:
+                continue
+            total = sum(_aval_bytes(l) for l in leaves)
+            if total < large_bytes:
+                continue
+            need = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
+            pool = list(out_avals)
+            if all(_take(pool, item) for item in need):
+                findings.append(Finding(
+                    "GC-J105",
+                    f"{label}: arg {i} ({total >> 20} MiB) matches the "
+                    f"outputs aval-for-aval but is not donated — add "
+                    f"donate_argnums=({i},) to reuse its buffers in place",
+                    source="jaxpr_lint", detail={"arg": i, "bytes": total}))
+    return findings
+
+
+def _take(pool: List, item) -> bool:
+    try:
+        pool.remove(item)
+        return True
+    except ValueError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# model-level entry points
+# ---------------------------------------------------------------------------
+
+
+def _model_structs(model, names: Sequence[str], batch: int):
+    specs = model.input_specs()
+    structs = []
+    for n in names:
+        key = n.split(":")[0]
+        if key not in specs:
+            raise KeyError(f"{key!r} is not a model input; inputs: "
+                           f"{sorted(specs)}")
+        shape, dtype = specs[key]
+        shape = tuple(batch if d is None else int(d) for d in shape)
+        structs.append(jax.ShapeDtypeStruct(shape, np.dtype(dtype)))
+    return structs
+
+
+def lint_train_step(model, input_name, label_name=None, optimizer="adam",
+                    *, batch: int = 8, mesh=None,
+                    params_spec=None, data_spec=None,
+                    donate_state: bool = True,
+                    ignore: Sequence[str] = (),
+                    large_bytes: int = DEFAULT_LARGE_BYTES,
+                    name: Optional[str] = None) -> List[Finding]:
+    """Lint one optimizer step of ``model`` exactly as the trainer builds
+    it (:func:`sparkflow_tpu.core.make_train_step`'s raw body): masked loss,
+    optimizer update, parameter apply. ``optimizer`` is a registry name or
+    an optax transformation. ``donate_state=True`` mirrors core's
+    ``donate_argnums=(0, 1)`` — set False to re-check donation advice."""
+    import optax
+
+    from ..core import make_loss_fn, _step_body
+    from ..optimizers import build_optimizer
+
+    if isinstance(optimizer, str):
+        opt_label, optimizer = optimizer, build_optimizer(optimizer, 0.01)
+    else:
+        opt_label = type(optimizer).__name__
+    loss_fn = make_loss_fn(model, input_name, label_name)
+    step = _step_body(loss_fn, optimizer)
+
+    multi = isinstance(input_name, (list, tuple))
+    names = list(input_name) if multi else [input_name]
+    x_structs = _model_structs(model, names, batch)
+    x = tuple(x_structs) if multi else x_structs[0]
+    if label_name is not None:
+        y = _model_structs(model, [label_name], batch)[0]
+    else:
+        y = jax.ShapeDtypeStruct((batch, 1), np.float32)  # ignored dummy
+    mask = jax.ShapeDtypeStruct((batch,), np.float32)
+    rng = jax.random.PRNGKey(0)
+    params = jax.eval_shape(model.init, rng)
+    opt_state = jax.eval_shape(optimizer.init, params)
+
+    in_specs = None
+    if params_spec is not None or data_spec is not None:
+        rows = data_spec if data_spec is not None else P()
+        in_specs = (params_spec, params_spec,
+                    rows, rows, rows, P())
+    return lint_fn(
+        step, (params, opt_state, x, y, mask, rng),
+        in_specs=in_specs, mesh=mesh,
+        donate_argnums=(0, 1) if donate_state else (),
+        name=name or f"train_step[{getattr(model, 'name', type(model).__name__)}"
+                     f"/{opt_label}]",
+        large_bytes=large_bytes, ignore=ignore)
+
+
+def lint_apply(model, input_name, output_name, *, batch: int = 8,
+               mesh=None, params_spec=None, data_spec=None,
+               ignore: Sequence[str] = (),
+               large_bytes: int = DEFAULT_LARGE_BYTES,
+               name: Optional[str] = None) -> List[Finding]:
+    """Lint the inference path: ``apply(params, x) -> output_name``."""
+    multi = isinstance(input_name, (list, tuple))
+    names = list(input_name) if multi else [input_name]
+    in_keys = [n.split(":")[0] for n in names]
+
+    def predict(params, x):
+        feeds = dict(zip(in_keys, tuple(x) if multi else (x,)))
+        return model.apply(params, feeds, [output_name],
+                           train=False)[output_name]
+
+    x_structs = _model_structs(model, names, batch)
+    x = tuple(x_structs) if multi else x_structs[0]
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    in_specs = None
+    if params_spec is not None or data_spec is not None:
+        in_specs = (params_spec, data_spec)
+    return lint_fn(predict, (params, x), in_specs=in_specs, mesh=mesh,
+                   name=name or f"apply[{type(model).__name__}"
+                                f"/{output_name}]",
+                   large_bytes=large_bytes, ignore=ignore)
+
+
+# ---------------------------------------------------------------------------
+# repo self-check: the presets x the optimizer registry
+# ---------------------------------------------------------------------------
+
+
+def repo_self_check(ignore: Sequence[str] = ()) -> List[Finding]:
+    """Trace-lint the repo's own model presets and optimizer registry —
+    the hot paths every example and test trains. Any finding here is a
+    repo bug; ``tests/test_analysis.py`` pins this to zero."""
+    from ..models import model_from_json, presets
+    from ..optimizers import AVAILABLE_OPTIMIZERS
+
+    findings: List[Finding] = []
+    mlp = model_from_json(presets.mlp(16, 4, hidden=(8,)))
+    # every registry optimizer across the mlp step: this is where Python
+    # scalar literals (lr, eps, decay math) would promote dtypes
+    for opt in AVAILABLE_OPTIMIZERS:
+        findings.extend(lint_train_step(
+            mlp, "x:0", "y:0", opt, batch=4, ignore=ignore,
+            name=f"train_step[mlp/{opt}]"))
+    cnn = model_from_json(presets.cnn(side=12, channels=1, num_classes=4))
+    findings.extend(lint_train_step(cnn, "x:0", "y:0", "adam", batch=4,
+                                    ignore=ignore,
+                                    name="train_step[cnn/adam]"))
+    ae = model_from_json(presets.autoencoder(input_dim=12, widths=(8, 4, 8)))
+    findings.extend(lint_train_step(ae, "x:0", None, "adam", batch=4,
+                                    ignore=ignore,
+                                    name="train_step[autoencoder/adam]"))
+    findings.extend(lint_apply(mlp, "x:0", "out:0", batch=4, ignore=ignore,
+                               name="apply[mlp/out]"))
+    return findings

@@ -1,0 +1,304 @@
+"""Runtime guards (GC-R4xx): catch silent retraces while the program runs.
+
+``jax.jit`` never says when it recompiles — a dtype drift, a ragged batch,
+or an unhashed config object just quietly costs seconds per step. The
+:class:`RecompileGuard` makes retraces observable: the wrapped function's
+Python body runs exactly once per trace, so counting executions counts
+compilations, and diffing the argument signature between traces names
+*which* argument's shape/dtype/static value changed.
+
+Two ways in:
+
+- ``RecompileGuard(fn)`` — owns the jit: call the guard like the jitted
+  function. ``guard.retraces`` / ``guard.report()`` / ``guard.findings()``.
+- ``guard.wrap(fn)`` — instrument ``fn`` for an external ``jit`` /
+  ``lower().compile()`` pipeline (how the serving engine counts its AOT
+  bucket ladder: every bucket compile is an expected trace, anything after
+  :meth:`mark_steady` is a regression).
+
+:func:`track_recompiles` is the fit-level hook: inside the context every
+``trace_probe``-instrumented build (the core train/epoch steps) reports
+traces to the tracker, and the trainer's ``debug_recompiles=True`` wires
+it up end to end. Probes are zero-cost when no tracker is active — the
+lookup happens at *trace* time, which is already paying a compile.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .findings import Finding
+
+__all__ = ["RecompileGuard", "track_recompiles", "trace_probe",
+           "describe_signature_diff"]
+
+logger = logging.getLogger("sparkflow_tpu")
+
+
+def _leaf_sig(leaf) -> Tuple:
+    aval = getattr(leaf, "aval", None)
+    if aval is not None and hasattr(aval, "shape"):  # a tracer
+        return ("array", tuple(aval.shape), str(aval.dtype),
+                bool(getattr(aval, "weak_type", False)))
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return ("array", tuple(leaf.shape), str(leaf.dtype),
+                bool(getattr(leaf, "weak_type", False)))
+    return ("static", repr(leaf))
+
+
+def _signature(args: Tuple, kwargs: Dict) -> List[Tuple[str, Tuple]]:
+    """Flat [(path, leaf signature)] for one call's arguments. Paths are
+    jax keystrs (``[0]['params']...``) so diffs name the exact leaf."""
+    flat = jax.tree_util.tree_flatten_with_path((args, kwargs))[0]
+    return [(jax.tree_util.keystr(path), _leaf_sig(leaf))
+            for path, leaf in flat]
+
+
+def describe_signature_diff(old: List[Tuple[str, Tuple]],
+                            new: List[Tuple[str, Tuple]]) -> str:
+    """Human-readable first difference between two call signatures."""
+    old_d, new_d = dict(old), dict(new)
+    if set(old_d) != set(new_d):
+        gained = sorted(set(new_d) - set(old_d))[:3]
+        lost = sorted(set(old_d) - set(new_d))[:3]
+        return (f"pytree structure changed (new leaves: {gained or '[]'}, "
+                f"dropped leaves: {lost or '[]'})")
+    diffs = []
+    for path, sig in new:
+        prev = old_d.get(path)
+        if prev != sig:
+            diffs.append(f"arg{path}: {_render_sig(prev)} -> "
+                         f"{_render_sig(sig)}")
+    if not diffs:
+        return "signatures identical (cache evicted or first trace)"
+    shown = "; ".join(diffs[:3])
+    more = f" (+{len(diffs) - 3} more)" if len(diffs) > 3 else ""
+    return shown + more
+
+
+def _render_sig(sig: Optional[Tuple]) -> str:
+    if sig is None:
+        return "<absent>"
+    if sig[0] == "array":
+        _, shape, dtype, weak = sig
+        return f"{dtype}{list(shape)}{' (weak)' if weak else ''}"
+    return f"static {sig[1]}"
+
+
+class RecompileGuard:
+    """Count (re)traces of one function and name what caused each.
+
+    Parameters
+    ----------
+    fn : callable | None
+        With a function, the guard jits it (``jit_kwargs`` forwarded) and
+        is called in its place. With None, use :meth:`wrap` to instrument
+        a function for an external jit/AOT pipeline.
+    warn_after : int
+        Retrace count beyond which each further trace logs a warning and
+        :meth:`findings` reports GC-R401. The first trace is free; a
+        bucket-ladder AOT warmup should raise it (or use
+        :meth:`mark_steady`).
+    """
+
+    def __init__(self, fn: Optional[Callable] = None, *,
+                 name: Optional[str] = None, warn_after: int = 1,
+                 jit_kwargs: Optional[Dict[str, Any]] = None):
+        self.name = name or (getattr(fn, "__name__", "fn") if fn else "fn")
+        self.warn_after = int(warn_after)
+        self._lock = threading.Lock()
+        self._sigs: List[List[Tuple[str, Tuple]]] = []
+        self._causes: List[str] = []
+        self._steady_at: Optional[int] = None
+        self._jitted = (jax.jit(self.wrap(fn), **(jit_kwargs or {}))
+                        if fn is not None else None)
+
+    def wrap(self, fn: Callable) -> Callable:
+        """Instrument ``fn``: its Python body runs once per trace, so the
+        wrapper records one signature per compilation."""
+
+        @functools.wraps(fn)
+        def probed(*args, **kwargs):
+            self._record(_signature(args, kwargs))
+            return fn(*args, **kwargs)
+
+        return probed
+
+    def _record(self, sig: List[Tuple[str, Tuple]]) -> None:
+        with self._lock:
+            cause = (describe_signature_diff(self._sigs[-1], sig)
+                     if self._sigs else "first trace")
+            self._sigs.append(sig)
+            self._causes.append(cause)
+            traces = len(self._sigs)
+            steady = self._steady_at
+        if steady is not None and traces > steady:
+            logger.warning("RecompileGuard[%s]: retrace after steady state "
+                           "(#%d): %s", self.name, traces, cause)
+        elif traces > self.warn_after:
+            logger.warning("RecompileGuard[%s]: retrace #%d: %s",
+                           self.name, traces, cause)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            raise TypeError("RecompileGuard was built without a function; "
+                            "use .wrap(fn) and call the wrapped pipeline")
+        return self._jitted(*args, **kwargs)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def traces(self) -> int:
+        with self._lock:
+            return len(self._sigs)
+
+    @property
+    def retraces(self) -> int:
+        return max(0, self.traces - 1)
+
+    @property
+    def causes(self) -> List[str]:
+        with self._lock:
+            return list(self._causes)
+
+    def mark_steady(self) -> None:
+        """Declare warmup over: every trace so far was expected, any trace
+        after this is a regression (``steady_traces`` counts them)."""
+        with self._lock:
+            self._steady_at = len(self._sigs)
+
+    @property
+    def steady_traces(self) -> int:
+        """Traces since :meth:`mark_steady` (0 before it's called)."""
+        with self._lock:
+            if self._steady_at is None:
+                return 0
+            return len(self._sigs) - self._steady_at
+
+    def findings(self) -> List[Finding]:
+        out = []
+        with self._lock:
+            traces = len(self._sigs)
+            causes = list(self._causes)
+            steady = self._steady_at
+        excess = (traces - steady if steady is not None
+                  else traces - self.warn_after)
+        if excess > 0 and traces > 1:
+            out.append(Finding(
+                "GC-R401",
+                f"{self.name} traced {traces}x "
+                f"({excess} beyond budget); last cause: {causes[-1]}",
+                source="runtime_guard",
+                detail={"traces": traces, "causes": causes}))
+        return out
+
+    def report(self) -> str:
+        with self._lock:
+            lines = [f"RecompileGuard[{self.name}]: "
+                     f"{len(self._sigs)} trace(s)"]
+            lines += [f"  #{i + 1}: {c}"
+                      for i, c in enumerate(self._causes)]
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# fit-level tracking: trace probes + an ambient tracker
+# ---------------------------------------------------------------------------
+
+_tracker_stack = threading.local()
+
+
+def _current_tracker() -> Optional["_Tracker"]:
+    stack = getattr(_tracker_stack, "stack", None)
+    return stack[-1] if stack else None
+
+
+class _Tracker:
+    """Collects per-probe trace signatures inside a track_recompiles()."""
+
+    def __init__(self, warn_after: int = 1):
+        self.warn_after = warn_after
+        self._lock = threading.Lock()
+        self._sigs: Dict[str, List[List[Tuple[str, Tuple]]]] = {}
+        self._causes: Dict[str, List[str]] = {}
+
+    def record(self, name: str, sig: List[Tuple[str, Tuple]]) -> None:
+        with self._lock:
+            sigs = self._sigs.setdefault(name, [])
+            causes = self._causes.setdefault(name, [])
+            cause = (describe_signature_diff(sigs[-1], sig) if sigs
+                     else "first trace")
+            sigs.append(sig)
+            causes.append(cause)
+            count = len(sigs)
+        if count > self.warn_after:
+            logger.warning("recompile: %s traced #%d: %s", name, count,
+                           cause)
+
+    @property
+    def traces(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: len(v) for k, v in self._sigs.items()}
+
+    def findings(self) -> List[Finding]:
+        out = []
+        with self._lock:
+            items = [(k, len(v), self._causes[k][-1])
+                     for k, v in self._sigs.items()]
+        for name, count, last in items:
+            if count > self.warn_after:
+                out.append(Finding(
+                    "GC-R401",
+                    f"{name} traced {count}x inside one fit "
+                    f"(budget {self.warn_after}); last cause: {last}",
+                    source="runtime_guard",
+                    detail={"traces": count}))
+        return out
+
+    def report(self) -> str:
+        with self._lock:
+            if not self._sigs:
+                return "no traced builds inside track_recompiles()"
+            lines = []
+            for name, sigs in self._sigs.items():
+                lines.append(f"{name}: {len(sigs)} trace(s)")
+                lines += [f"  #{i + 1}: {c}"
+                          for i, c in enumerate(self._causes[name])]
+        return "\n".join(lines)
+
+
+@contextmanager
+def track_recompiles(warn_after: int = 1):
+    """Activate retrace tracking for ``trace_probe``-instrumented builds on
+    this thread. Yields the tracker; read ``tracker.traces`` /
+    ``tracker.findings()`` / ``tracker.report()`` after the workload."""
+    tracker = _Tracker(warn_after=warn_after)
+    stack = getattr(_tracker_stack, "stack", None)
+    if stack is None:
+        stack = _tracker_stack.stack = []
+    stack.append(tracker)
+    try:
+        yield tracker
+    finally:
+        stack.pop()
+
+
+def trace_probe(fn: Callable, name: str) -> Callable:
+    """Instrument a to-be-jitted function body so an ambient
+    :func:`track_recompiles` tracker sees its traces. Free when no tracker
+    is active (one thread-local read per *trace*, not per call)."""
+
+    @functools.wraps(fn)
+    def probed(*args, **kwargs):
+        tracker = _current_tracker()
+        if tracker is not None:
+            tracker.record(name, _signature(args, kwargs))
+        return fn(*args, **kwargs)
+
+    return probed

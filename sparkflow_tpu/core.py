@@ -37,6 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .analysis.runtime_guards import trace_probe
 from .graphdef import GraphModel
 
 
@@ -137,7 +138,7 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     :func:`~sparkflow_tpu.parallel.tp.shard_params`) instead of pinning them
     replicated.
     """
-    step = _step_body(loss_fn, optimizer)
+    step = trace_probe(_step_body(loss_fn, optimizer), "train_step")
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
@@ -172,6 +173,7 @@ def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh],
     (pure dp). ``opt_shardings`` overrides just the opt-state in/out sharding
     with a matching NamedSharding pytree — the zero1 path, where the state
     shards over dp while params stay replicated."""
+    fn = trace_probe(fn, getattr(fn, "__name__", "epoch_fn"))
     if mesh is None:
         return jax.jit(fn, donate_argnums=(0, 1))
     fn = _sharded_trace_guard(fn, mesh)

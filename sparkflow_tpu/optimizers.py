@@ -28,6 +28,7 @@ from typing import Any, Dict, NamedTuple, Optional
 import chex
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -404,8 +405,12 @@ def _build_base_optimizer(optimizer_name: str, lr, opts
         # and params stay f32) — the standard large-model memory lever; the
         # update math still runs f32 (optax upcasts mu before use)
         mu_dtype = _pop(opts, "mu_dtype", default=None)
-        return optax.adam(lr, b1=_pop(opts, "beta1", "b1", default=0.9),
-                          b2=_pop(opts, "beta2", "b2", default=0.999),
+        # betas pinned to f32: optax's bias correction computes decay**count,
+        # and a Python-float decay is a weak f64 under x64 — the pow would
+        # silently promote the correction (graftcheck GC-J103)
+        return optax.adam(lr,
+                          b1=np.float32(_pop(opts, "beta1", "b1", default=0.9)),
+                          b2=np.float32(_pop(opts, "beta2", "b2", default=0.999)),
                           eps=_pop(opts, "epsilon", "eps", default=1e-8),
                           mu_dtype=mu_dtype)
     if optimizer_name == "rmsprop":

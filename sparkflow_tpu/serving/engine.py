@@ -30,6 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis.runtime_guards import RecompileGuard
 from ..core import _sharded_trace_guard
 from ..resilience import faults
 from ..utils import metrics as metrics_mod
@@ -131,6 +132,10 @@ class InferenceEngine:
         self.buckets = _bucket_ladder(self.max_batch)
         self._compiled: Dict[int, Any] = {}
         self._compile_lock = threading.Lock()
+        self._stats_lock = threading.Lock()  # request counters only
+        # one expected trace per ladder bucket; anything beyond warns
+        self.recompile_guard = RecompileGuard(name="serving.predict",
+                                              warn_after=len(self.buckets))
         self.aot_compiles = 0
         self.fallback_compiles = 0
         self._requests = 0
@@ -213,7 +218,10 @@ class InferenceEngine:
         return structs if self._multi else structs[0]
 
     def _compile_bucket(self, bucket: int):
-        predict = self._apply_fn()
+        # guard-wrapped so every trace (one per bucket compile) is counted;
+        # after warmup() marks steady state, any further trace is a
+        # regression the ladder was supposed to prevent (GC-R401)
+        predict = self.recompile_guard.wrap(self._apply_fn())
         params_struct = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
             if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -243,6 +251,7 @@ class InferenceEngine:
                     with annotate(f"serving/aot_compile_b{b}"):
                         self._compiled[b] = self._compile_bucket(b)
                     self.aot_compiles += 1
+            self.recompile_guard.mark_steady()
 
     def _executable(self, bucket: int):
         exe = self._compiled.get(bucket)
@@ -284,8 +293,9 @@ class InferenceEngine:
         if n == 0:
             probe = self._run(tuple(a[:0] for a in xs), 0, probe_rows=1)
             return probe[:0]
-        self._requests += 1
-        self._rows += n
+        with self._stats_lock:
+            self._requests += 1
+            self._rows += n
         if n > self.max_batch:
             outs = [self._run(tuple(a[i:i + self.max_batch] for a in xs),
                               min(self.max_batch, n - i))
@@ -313,11 +323,15 @@ class InferenceEngine:
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            requests, rows = self._requests, self._rows
         return {"buckets": list(self.buckets),
                 "aot_compiles": self.aot_compiles,
                 "fallback_compiles": self.fallback_compiles,
-                "requests": self._requests,
-                "rows": self._rows,
+                "traces": self.recompile_guard.traces,
+                "steady_traces": self.recompile_guard.steady_traces,
+                "requests": requests,
+                "rows": rows,
                 "quantize": self.quantize,
                 "mesh": (dict(self.mesh.shape) if self.mesh is not None
                          else None)}

@@ -33,6 +33,7 @@ star mandates synchronous all-reduce):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -132,7 +133,8 @@ class Trainer:
                  halt_on_nan: bool = False,
                  pp_microbatches: Optional[int] = None,
                  pp_schedule: str = "gpipe",
-                 weight_update_sharding: str = "auto"):
+                 weight_update_sharding: str = "auto",
+                 debug_recompiles: bool = False):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -194,6 +196,12 @@ class Trainer:
                 f"weight_update_sharding must be 'auto', 'on', or 'off'; "
                 f"got {weight_update_sharding!r}")
         self.weight_update_sharding = weight_update_sharding
+        # debug_recompiles=True runs each fit under analysis.track_recompiles:
+        # every train/epoch-step trace is counted and diffed, and the report
+        # lands in self.recompile_report / self.recompile_findings
+        self.debug_recompiles = bool(debug_recompiles)
+        self.recompile_report: Optional[str] = None
+        self.recompile_findings: list = []
         self._zero1_active = False
         # divergence detection: a non-finite epoch loss always WARNS
         # (post-hoc on the fused path); halt_on_nan=True additionally stops
@@ -593,8 +601,29 @@ class Trainer:
                            if k != "rng_impl"}
             return ckpt_mgr.restore(like=legacy_like)
 
+    @contextlib.contextmanager
+    def _recompile_scope(self):
+        """With ``debug_recompiles``, run the fit under
+        :func:`~sparkflow_tpu.analysis.runtime_guards.track_recompiles` and
+        keep the tracker's report/findings on the trainer afterwards."""
+        if not self.debug_recompiles:
+            yield
+            return
+        from .analysis.runtime_guards import track_recompiles
+        with track_recompiles() as tracker:
+            try:
+                yield
+            finally:
+                self.recompile_report = tracker.report()
+                self.recompile_findings = tracker.findings()
+
     def fit(self, features, labels: Optional[np.ndarray] = None,
             init_params=None) -> TrainResult:
+        with self._recompile_scope():
+            return self._fit_impl(features, labels, init_params)
+
+    def _fit_impl(self, features, labels: Optional[np.ndarray] = None,
+                  init_params=None) -> TrainResult:
         # multi-input features travel as a TUPLE of arrays; a plain list is
         # row data (np.asarray coercible), exactly as in single-input fits
         multi = isinstance(features, tuple)
@@ -993,6 +1022,13 @@ class Trainer:
 
     def fit_stream(self, row_iterator, init_params=None, queue_capacity: int = 8,
                    chunk: int = 1024, epochs: int = 1) -> TrainResult:
+        with self._recompile_scope():
+            return self._fit_stream_impl(row_iterator, init_params,
+                                         queue_capacity, chunk, epochs)
+
+    def _fit_stream_impl(self, row_iterator, init_params=None,
+                         queue_capacity: int = 8, chunk: int = 1024,
+                         epochs: int = 1) -> TrainResult:
         """Streaming fit for datasets that don't fit in device memory.
 
         ``row_iterator`` yields ``(features, label)`` pairs (bare features when

@@ -77,8 +77,9 @@ class Metrics:
         self._counters: Dict[str, float] = defaultdict(float)
         self._hists: Dict[str, _Histogram] = {}
         self._listeners: List[Callable[[str, float, int], None]] = []
-        # serving handlers record from many threads; scalar/counter dict
-        # updates are GIL-atomic but histogram reservoir updates are not
+        # serving handlers record from many threads; counter += and
+        # histogram reservoir updates are read-modify-write, so both take
+        # the lock (list.append in scalar() is atomic and stays lock-free)
         self._hist_lock = threading.Lock()
 
     def scalar(self, name: str, value: float, step: Optional[int] = None) -> None:
@@ -88,7 +89,8 @@ class Metrics:
             fn(name, float(value), step)
 
     def incr(self, name: str, amount: float = 1.0) -> None:
-        self._counters[name] += amount
+        with self._hist_lock:
+            self._counters[name] += amount
 
     def observe(self, name: str, value: float) -> None:
         """Record one observation into the ``name`` histogram (latencies,
