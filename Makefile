@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft obs-smoke span-overhead
 
 test:
 	python -m pytest tests/ -q
@@ -77,6 +77,15 @@ chaos-smoke:
 # source + the jaxpr self-check over presets x optimizers (docs/analysis.md)
 lint-graft:
 	JAX_PLATFORMS=cpu python -m sparkflow_tpu.analysis sparkflow_tpu examples
+
+# observability smoke: the spans/stepstats/prometheus/request-tracing suite,
+# then the span-overhead micro-bench (docs/observability.md)
+obs-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+	JAX_PLATFORMS=cpu python bench.py --span-overhead
+
+span-overhead:
+	JAX_PLATFORMS=cpu python bench.py --span-overhead
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:

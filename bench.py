@@ -187,5 +187,113 @@ def main():
     print(json.dumps(out))
 
 
+def span_overhead_main():
+    """Micro-bench for the obs layer: run the same jitted train step in a
+    tight loop with and without ``obs.span`` instrumentation and report the
+    relative overhead. Prints ONE JSON line:
+    {"metric": "span_overhead_pct", "value", "unit", "threshold_pct", "pass"}.
+
+    The step is small but real — value_and_grad of an MSE through a
+    (512,256)@(256,128) matmul plus an SGD update — so the denominator
+    includes one genuine XLA dispatch per step, which is what a span wraps
+    in practice.
+
+    Methodology: the added work per traced step is exactly two span
+    enter/exits (the outer per-step span plus one nested phase span, the
+    shape ``Trainer.fit(trace_spans=True)`` emits), so that pair is timed
+    in a tight loop where it is measurable to ~2% — and divided by the
+    measured per-step time. A direct A/B difference of two ~1e2..1e3us
+    step loops cannot resolve a sub-5% effect on a shared host (scheduler
+    and frequency noise is itself +/-3-5% of the step at any size; in
+    calibration it produced deltas from -4.6% to +9% for the same code),
+    so the A/B delta is reported only as a diagnostic field.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from sparkflow_tpu.obs import Tracer
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(512, 256).astype(np.float32))
+    w = jnp.asarray(rs.rand(256, 128).astype(np.float32) * 0.1)
+    y = jnp.asarray(rs.rand(512, 128).astype(np.float32))
+
+    @jax.jit
+    def step(w):
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 1e-3 * g, l
+
+    # warm up the compile so neither loop pays it
+    w2, l = step(w)
+    jax.block_until_ready((w2, l))
+
+    tr = Tracer()
+
+    # (1) cost of the added instrumentation, isolated: one nested span pair
+    # per iteration, exactly the per-step shape the traced loop below adds.
+    # Tight-loop minima are stable to ~2% where A/B step-loop deltas are not.
+    pair_iters = 50000
+
+    def pair_loop():
+        t0 = time.perf_counter()
+        with tr.activate():
+            for i in range(pair_iters):
+                with tr.span("bench/step", args={"i": i}):
+                    with tr.span("bench/compute"):
+                        pass
+        return (time.perf_counter() - t0) / pair_iters
+
+    span_pair_s = min(pair_loop() for _ in range(3))
+
+    # (2) per-step time of the real jitted loop, plain vs traced,
+    # interleaved (the traced number feeds the diagnostic A/B delta only)
+    seg = 50
+
+    def plain_seg():
+        wi = w
+        t0 = time.perf_counter()
+        for _ in range(seg):
+            wi, li = step(wi)
+            jax.block_until_ready(li)
+        return (time.perf_counter() - t0) / seg
+
+    def traced_seg():
+        wi = w
+        t0 = time.perf_counter()
+        with tr.activate():
+            for i in range(seg):
+                with tr.span("bench/step", args={"i": i}):
+                    with tr.span("bench/compute"):
+                        wi, li = step(wi)
+                        jax.block_until_ready(li)
+        return (time.perf_counter() - t0) / seg
+
+    plain, traced = 1e9, 1e9
+    for _ in range(10):
+        plain = min(plain, plain_seg())
+        traced = min(traced, traced_seg())
+
+    overhead_pct = span_pair_s / plain * 100.0
+
+    out = {
+        "metric": "span_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "threshold_pct": 5.0,
+        "pass": overhead_pct < 5.0,
+        "spans_per_step": 2,
+        "span_pair_us": round(span_pair_s * 1e6, 3),
+        "plain_step_us": round(plain * 1e6, 2),
+        "ab_delta_pct_diagnostic": round((traced - plain) / plain * 100.0, 2),
+    }
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
-    main()
+    if "--span-overhead" in sys.argv:
+        span_overhead_main()
+    else:
+        main()

@@ -115,6 +115,13 @@ class CheckpointManager:
         os.replace(tmp, final)
 
     def save(self, step: int, state: Dict[str, Any]) -> None:
+        # spanned so a traced fit shows checkpoint time as its own phase
+        # child (routes to the fit's tracer via obs activation)
+        from .obs.spans import span as obs_span
+        with obs_span("checkpoint/save", args={"step": int(step)}):
+            self._save_impl(step, state)
+
+    def _save_impl(self, step: int, state: Dict[str, Any]) -> None:
         final = self._step_dir(step)
         # the tmp name intentionally fails all_steps's int parse, so a crash
         # mid-save leaves a dir no reader ever mistakes for a checkpoint
@@ -248,6 +255,15 @@ class CheckpointManager:
         :class:`CheckpointError` when steps exist but none restores. An
         explicit ``step`` never falls back: corruption there raises.
         """
+        from .obs.spans import span as obs_span
+        with obs_span("checkpoint/restore",
+                      args={"step": (int(step) if step is not None
+                                     else None)}):
+            return self._restore_impl(step, like, verify)
+
+    def _restore_impl(self, step: Optional[int],
+                      like: Optional[Dict[str, Any]],
+                      verify: bool) -> Optional[Dict[str, Any]]:
         explicit = step is not None
         if explicit:
             candidates = [step]

@@ -1,0 +1,23 @@
+"""Unified observability: spans, step-phase stats, metric exporters.
+
+- :mod:`~sparkflow_tpu.obs.spans` — ``Span``/``Tracer``: nested host-side
+  timing with Chrome-trace / JSONL export and cross-thread propagation.
+- :mod:`~sparkflow_tpu.obs.stepstats` — ``StepStats``: per-step phase
+  breakdown (transfer / compile / step / metrics / checkpoint) + derived
+  throughput and MFU gauges for ``Trainer.fit``.
+- :mod:`~sparkflow_tpu.obs.exporters` — ``prometheus_text`` exposition of
+  the whole metrics registry and the ``MemoryWatcher`` device-memory
+  sampler.
+
+See ``docs/observability.md`` for the end-to-end walkthrough.
+"""
+
+from .spans import Span, Tracer, current_tracer, default_tracer, span
+from .stepstats import StepStats
+from .exporters import MemoryWatcher, prometheus_name, prometheus_text
+
+__all__ = [
+    "Span", "Tracer", "current_tracer", "default_tracer", "span",
+    "StepStats",
+    "MemoryWatcher", "prometheus_name", "prometheus_text",
+]

@@ -119,4 +119,12 @@ class RetryPolicy:
                     raise RetryExhausted(op, attempt, elapsed, e) from e
                 if on_retry is not None:
                     on_retry(attempt, delay, e)
-                self.sleep(delay)
+                # the backoff wait becomes a span: a trace of a slow fit or
+                # a long replica start shows WHERE the time went — sleeping
+                # out retries — and names the error that caused each one
+                from ..obs.spans import span as obs_span
+                with obs_span("retry/backoff",
+                              args={"op": op, "attempt": attempt,
+                                    "delay_s": round(delay, 6),
+                                    "error": type(e).__name__}):
+                    self.sleep(delay)

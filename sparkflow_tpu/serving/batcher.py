@@ -23,6 +23,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import spans as spans_mod
 from ..utils import metrics as metrics_mod
 
 
@@ -39,12 +40,15 @@ class Draining(QueueFull):
 
 
 class _Pending:
-    __slots__ = ("rows", "future", "enqueued_at")
+    __slots__ = ("rows", "future", "enqueued_at", "request_id", "parent")
 
-    def __init__(self, rows, future, enqueued_at):
+    def __init__(self, rows, future, enqueued_at, request_id=None,
+                 parent=None):
         self.rows = rows
         self.future = future
         self.enqueued_at = enqueued_at
+        self.request_id = request_id  # X-Request-Id from the HTTP front
+        self.parent = parent  # submitter's open Span (cross-thread link)
 
 
 class MicroBatcher:
@@ -68,7 +72,8 @@ class MicroBatcher:
 
     def __init__(self, engine, *, max_batch: Optional[int] = None,
                  max_delay_ms: float = 2.0, max_queue: int = 1024,
-                 metrics: Optional[metrics_mod.Metrics] = None):
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 tracer: Optional[spans_mod.Tracer] = None):
         self.engine = engine
         self.max_batch = int(max_batch if max_batch is not None
                              else getattr(engine, "max_batch", 64))
@@ -79,6 +84,10 @@ class MicroBatcher:
         self.metrics = (metrics if metrics is not None
                         else getattr(engine, "metrics", None)
                         or metrics_mod.Metrics())
+        # request tracing: batch/compute spans land here, and the worker
+        # activates it so engine-level span() calls nest under them
+        self.tracer = (tracer if tracer is not None
+                       else spans_mod.default_tracer)
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -93,10 +102,21 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, x) -> "Future[np.ndarray]":
+    def submit(self, x, request_id: Optional[str] = None,
+               parent: Optional[spans_mod.Span] = None
+               ) -> "Future[np.ndarray]":
         """Queue one request (``[n, ...]`` array, or one unbatched row, or a
         tuple of arrays for multi-input engines) and return a Future that
-        resolves to its rows of the batched output."""
+        resolves to its rows of the batched output.
+
+        ``request_id`` rides along for tracing; ``parent`` (the caller's
+        open :class:`~sparkflow_tpu.obs.Span`) parents the worker-side
+        spans so the cross-thread chain stays connected. On completion the
+        Future additionally carries ``.request_id`` and ``.timing`` — the
+        per-request latency decomposition
+        ``{queue_wait_ms, batch_assembly_ms, compute_ms, total_ms}``
+        (set before the result is published, so ``result()`` returners
+        always see it)."""
         rows = self._as_rows(x)
         n = rows[0].shape[0]
         if n > self.max_batch:
@@ -116,7 +136,8 @@ class MicroBatcher:
                 raise QueueFull(
                     f"queue at capacity ({self._queued_rows}/{self.max_queue}"
                     f" rows); retry later")
-            self._pending.append(_Pending(rows, fut, time.perf_counter()))
+            self._pending.append(_Pending(rows, fut, time.perf_counter(),
+                                          request_id, parent))
             self._queued_rows += n
             self.metrics.observe("serving/queue_depth_rows",
                                  self._queued_rows)
@@ -225,46 +246,79 @@ class MicroBatcher:
             return batch
 
     def _loop(self) -> None:
-        while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            try:
-                self._serve(batch)
-            finally:
-                with self._cond:
-                    self._inflight_rows -= sum(p.rows[0].shape[0]
-                                               for p in batch)
-                    self._cond.notify_all()  # wait_drained watches this
+        # activate(): module-level span() calls made while serving (e.g. in
+        # the engine) land on this batcher's tracer, nested under the
+        # batch span, instead of on the process-default one
+        with self.tracer.activate():
+            while True:
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                try:
+                    self._serve(batch)
+                finally:
+                    with self._cond:
+                        self._inflight_rows -= sum(p.rows[0].shape[0]
+                                                   for p in batch)
+                        self._cond.notify_all()  # wait_drained watches this
 
     def _serve(self, batch: List[_Pending]) -> None:
         sizes = [p.rows[0].shape[0] for p in batch]
         total = sum(sizes)
         multi = len(batch[0].rows) > 1
-        try:
-            joined = tuple(
-                np.concatenate([p.rows[i] for p in batch], axis=0)
-                for i in range(len(batch[0].rows)))
-            t0 = time.perf_counter()
-            out = self.engine.predict(joined if multi else joined[0])
-            dt = time.perf_counter() - t0
-        except Exception as exc:  # noqa: BLE001 - fan the failure out
-            for p in batch:
-                if not p.future.cancelled():
-                    p.future.set_exception(exc)
-            self.metrics.incr("serving/batch_errors")
-            return
+        tracer = self.tracer
+        with tracer.span("serving/batch",
+                         args={"rows": total, "requests": len(batch)}):
+            try:
+                with tracer.span("serving/batch_assembly"):
+                    t_asm = time.perf_counter()
+                    joined = tuple(
+                        np.concatenate([p.rows[i] for p in batch], axis=0)
+                        for i in range(len(batch[0].rows)))
+                    t0 = time.perf_counter()
+                with tracer.span("serving/engine_compute"):
+                    out = self.engine.predict(joined if multi else joined[0])
+                    t1 = time.perf_counter()
+                dt = t1 - t0
+            except Exception as exc:  # noqa: BLE001 - fan the failure out
+                for p in batch:
+                    if not p.future.cancelled():
+                        p.future.set_exception(exc)
+                self.metrics.incr("serving/batch_errors")
+                return
+        asm_ms = (t0 - t_asm) * 1000.0
+        compute_ms = dt * 1000.0
         self.metrics.observe("serving/batch_rows", total)
         self.metrics.observe("serving/batch_fill_ratio",
                              total / self.max_batch)
+        self.metrics.observe("serving/batch_assembly_ms", asm_ms)
+        self.metrics.observe("serving/compute_ms", compute_ms)
         self.metrics.observe("serving/batch_latency_ms", dt * 1000.0)
         self.metrics.incr("serving/batches")
         self.metrics.incr("serving/requests", len(batch))
         offset = 0
         now = time.perf_counter()
         for p, n in zip(batch, sizes):
-            self.metrics.observe("serving/request_latency_ms",
-                                 (now - p.enqueued_at) * 1000.0)
+            queue_wait_ms = (t_asm - p.enqueued_at) * 1000.0
+            total_ms = (now - p.enqueued_at) * 1000.0
+            self.metrics.observe("serving/queue_wait_ms", queue_wait_ms)
+            self.metrics.observe("serving/request_latency_ms", total_ms)
+            # post-hoc span: the wait interval is only known once the batch
+            # forms; parent = the submitter's request span, so the chain
+            # reads request -> queue_wait even across threads
+            tracer.record("serving/queue_wait", p.enqueued_at, t_asm,
+                          parent=p.parent,
+                          args=({"request_id": p.request_id}
+                                if p.request_id else None))
             if not p.future.cancelled():
+                # attach BEFORE set_result: anyone woken by result() must
+                # already see the decomposition
+                p.future.request_id = p.request_id
+                p.future.timing = {
+                    "queue_wait_ms": queue_wait_ms,
+                    "batch_assembly_ms": asm_ms,
+                    "compute_ms": compute_ms,
+                    "total_ms": total_ms,
+                }
                 p.future.set_result(out[offset:offset + n])
             offset += n

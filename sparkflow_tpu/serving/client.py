@@ -58,17 +58,21 @@ class ServingClient:
             max_attempts=self.retries + 1, base_s=0.1, multiplier=2.0,
             max_s=5.0, jitter=0.5, seed=0)
 
-    def _request(self, path: str, payload: Optional[Dict[str, Any]] = None
-                 ) -> Dict[str, Any]:
+    def _request(self, path: str, payload: Optional[Dict[str, Any]] = None,
+                 headers: Optional[Dict[str, str]] = None,
+                 with_headers: bool = False):
         req = urllib.request.Request(
             self.url + path,
             data=(json.dumps(payload).encode("utf-8")
                   if payload is not None else None),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
             method="POST" if payload is not None else "GET")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read().decode("utf-8"))
+                body = json.loads(resp.read().decode("utf-8"))
+                if with_headers:
+                    return body, dict(resp.headers)
+                return body
         except urllib.error.HTTPError as exc:
             ra = exc.headers.get("Retry-After") if exc.headers else None
             try:
@@ -128,8 +132,33 @@ class ServingClient:
                         e) from e
                 policy.sleep(delay)
 
+    def predict_full(self, inputs,
+                     request_id: Optional[str] = None) -> Dict[str, Any]:
+        """One attempt (no retries), full reply: ``predictions``, ``rows``,
+        the server's ``request_id`` (yours, echoed, if you passed one) and
+        the per-request ``timing_ms`` latency decomposition. The echoed
+        ``X-Request-Id`` response header is surfaced as
+        ``x_request_id_header``."""
+        if isinstance(inputs, dict):
+            wire: Any = {k: np.asarray(v).tolist() for k, v in inputs.items()}
+        else:
+            wire = np.asarray(inputs).tolist()
+        body, hdrs = self._request(
+            "/v1/predict", {"inputs": wire},
+            headers=({"X-Request-Id": request_id} if request_id else None),
+            with_headers=True)
+        body["x_request_id_header"] = hdrs.get("X-Request-Id")
+        return body
+
     def healthz(self) -> Dict[str, Any]:
         return self._request("/healthz")
 
     def metrics(self) -> Dict[str, Any]:
         return self._request("/metrics")
+
+    def metrics_prometheus(self) -> str:
+        """Raw Prometheus text exposition from
+        ``GET /metrics?format=prometheus``."""
+        req = urllib.request.Request(self.url + "/metrics?format=prometheus")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read().decode("utf-8")

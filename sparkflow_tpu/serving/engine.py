@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..analysis.runtime_guards import RecompileGuard
 from ..core import _sharded_trace_guard
+from ..obs.spans import span as obs_span
 from ..resilience import faults
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
@@ -316,7 +317,11 @@ class InferenceEngine:
         self.metrics.observe("serving/engine_batch_rows", n)
         self.metrics.observe("serving/padding_waste",
                              (bucket - n) / bucket if bucket else 0.0)
-        with annotate("serving/engine_apply"):
+        # span + annotate: the host span routes to whatever tracer is
+        # active on this thread (the batcher worker's, usually), and the
+        # same named range still shows in JAX profiler captures
+        with obs_span("serving/engine_apply", args={"bucket": bucket},
+                      jax_annotation=True):
             out = exe(self._params, xs if self._multi else xs[0])
         return np.asarray(out)[:n]
 

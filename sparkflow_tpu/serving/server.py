@@ -20,9 +20,19 @@ Endpoints
     the lifecycle state; flips to ``503`` once the server is draining so
     load balancers eject the replica before its socket goes away.
 ``GET /metrics``
-    Full ``utils.metrics`` summary: counters, scalar series, and the serving
-    histograms (queue depth, batch fill ratio, padding waste, latency
-    p50/p95/p99).
+    Full ``utils.metrics`` summary: counters, gauges, scalar series, and the
+    serving histograms (queue depth, batch fill ratio, padding waste, latency
+    p50/p95/p99). ``GET /metrics?format=prometheus`` returns the same
+    registry in Prometheus text exposition format (``obs.exporters``) for a
+    stock scrape_config; JSON stays the default.
+
+Request tracing
+---------------
+Every ``POST /v1/predict`` gets an ``X-Request-Id`` (the client's, or a
+fresh one), threaded through the micro-batcher and echoed in the response
+headers and body together with a per-request latency decomposition
+(``timing_ms``: queue wait vs batch assembly vs compute). The same id
+labels the request's spans on the server's tracer.
 """
 
 from __future__ import annotations
@@ -31,11 +41,15 @@ import json
 import logging
 import signal as signal_mod
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs
 
 import numpy as np
 
+from ..obs import spans as spans_mod
+from ..obs.exporters import MemoryWatcher, prometheus_text
 from ..resilience.lifecycle import Lifecycle, ServerState
 from .batcher import Draining, MicroBatcher, QueueFull
 
@@ -62,14 +76,26 @@ class InferenceServer:
                  max_delay_ms: float = 2.0, max_queue: int = 1024,
                  request_timeout_s: float = 30.0,
                  drain_timeout_s: float = 10.0,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 tracer: Optional[spans_mod.Tracer] = None,
+                 memory_watch: bool = True,
+                 memory_interval_s: float = 5.0):
         self.engine = engine
+        self.tracer = (tracer if tracer is not None
+                       else spans_mod.default_tracer)
         self.batcher = batcher if batcher is not None else MicroBatcher(
-            engine, max_delay_ms=max_delay_ms, max_queue=max_queue)
+            engine, max_delay_ms=max_delay_ms, max_queue=max_queue,
+            tracer=self.tracer)
         self.metrics = self.batcher.metrics
         self.request_timeout_s = float(request_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.retry_after_s = float(retry_after_s)
+        # memory_watch: background mem/* gauges (per-device bytes_in_use /
+        # peak / limit) so a scrape sees how close the replica is to OOM;
+        # a no-op on backends whose allocator reports no stats (CPU)
+        self.memory_watcher = (MemoryWatcher(metrics=self.metrics,
+                                             interval_s=memory_interval_s)
+                               if memory_watch else None)
         self.lifecycle = Lifecycle()
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
@@ -89,6 +115,8 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="inference-server", daemon=True)
         self._thread.start()
+        if self.memory_watcher is not None:
+            self.memory_watcher.start()
         self.lifecycle.transition(ServerState.SERVING)
         return self
 
@@ -136,6 +164,8 @@ class InferenceServer:
         if self._thread is None:
             return
         self.drain()
+        if self.memory_watcher is not None:
+            self.memory_watcher.stop()
         self._httpd.shutdown()
         self._thread.join(timeout=10.0)
         self._httpd.server_close()
@@ -176,7 +206,10 @@ class InferenceServer:
                              "of rows, not an object")
         return np.asarray(inputs)
 
-    def _predict(self, body: bytes) -> Tuple:  # (status, body[, headers])
+    def _predict(self, body: bytes, request_id: str) -> Tuple:
+        # always (status, body, headers); the request id is echoed on every
+        # outcome so a client/log line can be joined to server-side spans
+        rid = {"X-Request-Id": request_id}
         try:
             payload = json.loads(body or b"{}")
             if not isinstance(payload, dict):
@@ -185,30 +218,43 @@ class InferenceServer:
         except (ValueError, TypeError) as exc:
             self.metrics.incr("serving/http_400")
             return 400, {"error": {"code": "bad_request",
-                                   "message": str(exc)}}
+                                   "message": str(exc)}}, rid
+        fut = None
         try:
-            out = self.batcher.predict(x, timeout=self.request_timeout_s)
+            with self.tracer.span("serving/request",
+                                  args={"request_id": request_id}) as sp:
+                fut = self.batcher.submit(x, request_id=request_id,
+                                          parent=sp)
+                out = fut.result(timeout=self.request_timeout_s)
         except Draining as exc:
             # the drain began after this request was admitted; shed it the
             # same way un-admitted ones are shed
             self.metrics.incr("serving/http_503")
             return 503, {"error": {"code": "draining",
-                                   "message": str(exc)}}, self._retry_after()
+                                   "message": str(exc)}}, \
+                {**self._retry_after(), **rid}
         except QueueFull as exc:
             self.metrics.incr("serving/http_503")
             return 503, {"error": {"code": "queue_full",
-                                   "message": str(exc)}}, self._retry_after()
+                                   "message": str(exc)}}, \
+                {**self._retry_after(), **rid}
         except ValueError as exc:
             self.metrics.incr("serving/http_400")
             return 400, {"error": {"code": "bad_request",
-                                   "message": str(exc)}}
+                                   "message": str(exc)}}, rid
         except Exception as exc:  # noqa: BLE001 - surface, don't hang
             self.metrics.incr("serving/http_500")
             return 500, {"error": {"code": "internal",
-                                   "message": f"{type(exc).__name__}: {exc}"}}
+                                   "message": f"{type(exc).__name__}: "
+                                              f"{exc}"}}, rid
         self.metrics.incr("serving/http_200")
-        return 200, {"predictions": np.asarray(out).tolist(),
-                     "rows": int(np.asarray(out).shape[0])}
+        resp: Dict[str, Any] = {"predictions": np.asarray(out).tolist(),
+                                "rows": int(np.asarray(out).shape[0]),
+                                "request_id": request_id}
+        timing = getattr(fut, "timing", None)
+        if timing is not None:
+            resp["timing_ms"] = {k: round(v, 3) for k, v in timing.items()}
+        return 200, resp, rid
 
     def _retry_after(self) -> Dict[str, str]:
         return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
@@ -233,6 +279,9 @@ class InferenceServer:
     def _metrics(self) -> Tuple[int, Dict[str, Any]]:
         return 200, self.metrics.summary()
 
+    def _metrics_prometheus(self) -> Tuple[int, str]:
+        return 200, prometheus_text(self.metrics)
+
     def _make_handler(self):
         server = self
 
@@ -250,11 +299,30 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _reply_text(self, status: int, text: str,
+                            content_type: str) -> None:
+                data = text.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     self._reply(*server._healthz())
-                elif self.path == "/metrics":
-                    self._reply(*server._metrics())
+                elif path == "/metrics":
+                    fmt = parse_qs(query).get("format", ["json"])[0]
+                    if fmt == "prometheus":
+                        status, text = server._metrics_prometheus()
+                        # the version suffix is part of the exposition
+                        # contract prometheus scrapers negotiate on
+                        self._reply_text(
+                            status, text,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    else:
+                        self._reply(*server._metrics())
                 else:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
@@ -264,6 +332,10 @@ class InferenceServer:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
                     return
+                # propagate the caller's correlation id, or mint one —
+                # either way every response carries X-Request-Id
+                request_id = (self.headers.get("X-Request-Id")
+                              or uuid.uuid4().hex)
                 # admission control: a draining/stopped server sheds the
                 # request BEFORE reading work into the batcher, with a
                 # Retry-After hint for the balancer's re-dispatch
@@ -272,12 +344,14 @@ class InferenceServer:
                     self._reply(503, {"error": {
                         "code": "draining",
                         "message": "server is draining; retry on another "
-                                   "replica"}}, server._retry_after())
+                                   "replica"}},
+                        {**server._retry_after(),
+                         "X-Request-Id": request_id})
                     return
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    self._reply(*server._predict(body))
+                    self._reply(*server._predict(body, request_id))
                 finally:
                     server.lifecycle.end_request()
 

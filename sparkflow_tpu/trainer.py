@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -237,6 +239,14 @@ class Trainer:
             from .utils.metrics import default_metrics
             metrics = default_metrics
         self.metrics = metrics
+        # span tracing (obs/): fit(trace_spans=...) fills these per run —
+        # the Tracer holding the spans, the StepStats phase summary, and
+        # the Chrome-trace path the fit exported
+        self.last_tracer = None
+        self.last_step_stats: Optional[dict] = None
+        self.last_trace_path: Optional[str] = None
+        self._tracer = None
+        self._step_stats = None
 
     # -- batching plan ------------------------------------------------------
 
@@ -618,9 +628,56 @@ class Trainer:
                 self.recompile_findings = tracker.findings()
 
     def fit(self, features, labels: Optional[np.ndarray] = None,
-            init_params=None) -> TrainResult:
+            init_params=None, *, trace_spans=False,
+            trace_dir: Optional[str] = None) -> TrainResult:
+        """Train. With ``trace_spans`` truthy, the fit runs instrumented:
+        per-step phase spans (transfer / compile / steady step / metrics /
+        checkpoint) are collected on a fresh :class:`~sparkflow_tpu.obs.Tracer`
+        and exported as Chrome-trace JSON + span JSONL (``trace_spans`` may
+        be the output path; otherwise one is derived from ``trace_dir``,
+        ``checkpoint_dir``, or the system temp dir — see
+        ``self.last_trace_path``). Phase totals and throughput/MFU gauges
+        land in ``self.last_step_stats`` and the metrics registry. Tracing
+        forces the per-epoch loop path (the fused multi-epoch program has
+        no host-visible step boundaries to time)."""
         with self._recompile_scope():
-            return self._fit_impl(features, labels, init_params)
+            if not trace_spans:
+                return self._fit_impl(features, labels, init_params)
+            from .obs import StepStats, Tracer
+            tracer = Tracer()
+            stats = StepStats(tracer=tracer, metrics=self.metrics)
+            self.last_tracer = tracer
+            self.last_step_stats = None
+            self._tracer, self._step_stats = tracer, stats
+            try:
+                # activate(): checkpoint/retry spans fired deep in the
+                # stack route to this fit's tracer, nested under the root.
+                # An ambient trace tracker must exist for the compile-vs-
+                # steady probe-count delta; reuse the debug_recompiles one
+                # when present (probes record to the innermost tracker
+                # only — pushing a second would starve the user's report)
+                from .analysis.runtime_guards import (_current_tracker,
+                                                      track_recompiles)
+                with contextlib.ExitStack() as es:
+                    if _current_tracker() is None:
+                        es.enter_context(track_recompiles(warn_after=10**9))
+                    es.enter_context(tracer.activate())
+                    es.enter_context(tracer.span("train/fit"))
+                    result = self._fit_impl(features, labels, init_params)
+            finally:
+                self._tracer = None
+                self._step_stats = None
+            self.last_step_stats = stats._summary
+            if isinstance(trace_spans, str):
+                path = trace_spans
+            else:
+                base = trace_dir or self.checkpoint_dir or tempfile.gettempdir()
+                path = os.path.join(
+                    base, f"sparkflow_tpu_trace_{os.getpid()}.json")
+            self.last_trace_path = tracer.export_chrome_trace(path)
+            tracer.export_jsonl(
+                (path[:-5] if path.endswith(".json") else path) + ".jsonl")
+            return result
 
     def _fit_impl(self, features, labels: Optional[np.ndarray] = None,
                   init_params=None) -> TrainResult:
@@ -782,8 +839,20 @@ class Trainer:
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
 
         # Stage the dataset on device(s) once; every epoch runs fully on-device.
-        device_args = (jax.tree.map(jnp.asarray, x_pad), jnp.asarray(y_pad),
-                       jnp.asarray(mask))
+        stats = self._step_stats  # set by fit(trace_spans=...), else None
+        if stats is not None:
+            # everything up to here (validation, plan, init, restore) is
+            # one-time setup; charging it keeps phase sums ≈ wall time
+            stats.add("setup", stats.elapsed_s())
+            # sync inside the phase so host->device transfer is charged
+            # here and not to the first step
+            with stats.phase("transfer"):
+                device_args = (jax.tree.map(jnp.asarray, x_pad),
+                               jnp.asarray(y_pad), jnp.asarray(mask))
+                jax.block_until_ready(device_args)
+        else:
+            device_args = (jax.tree.map(jnp.asarray, x_pad),
+                           jnp.asarray(y_pad), jnp.asarray(mask))
 
         loss_by_it = {}  # device scalars; converted lazily to keep async dispatch
         t0 = time.perf_counter()
@@ -804,9 +873,11 @@ class Trainer:
         else:
             step_fn = None
         k = total_epochs - start_epoch
+        # span tracing joins the needs-per-epoch-host-control set: the fused
+        # program is one opaque dispatch with no step boundaries to time
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
-                and not self.halt_on_nan):
+                and not self.halt_on_nan and stats is None):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
                     pspecs is not None, strategy,
@@ -852,6 +923,19 @@ class Trainer:
                 opt_shardings=opt_shardings)
         epoch_fn = self._epoch_cache[cache_key]
 
+        if stats is not None:
+            # compile-vs-steady detection: the core trace probes record
+            # every XLA trace on the ambient tracker (fit(trace_spans=...)
+            # guarantees one is active); a probe-count delta across the
+            # epoch call means that call paid a compile
+            from .analysis.runtime_guards import _current_tracker
+            stats.examples_per_step = (num_batches * batch
+                                       if mode == "stochastic" else n)
+
+            def _probe_count() -> int:
+                tr = _current_tracker()
+                return sum(tr.traces.values()) if tr is not None else 0
+
         from .utils.preempt import NullGuard, PreemptionGuard
         guard = PreemptionGuard() if ckpt_mgr is not None else NullGuard()
         preempted = False
@@ -887,33 +971,57 @@ class Trainer:
                             continue
                         te = time.perf_counter()
                         rng, erng = jax.random.split(rng)
-                        params, opt_state, losses = epoch_fn(params, opt_state,
-                                                             *device_args, erng)
+                        if stats is None:
+                            params, opt_state, losses = epoch_fn(
+                                params, opt_state, *device_args, erng)
+                        else:
+                            stats.begin_step()
+                            probes_before = _probe_count()
+                            ts0 = time.perf_counter()
+                            params, opt_state, losses = epoch_fn(
+                                params, opt_state, *device_args, erng)
+                            # sync so the step phase owns its real device
+                            # time (async dispatch would smear it into the
+                            # metrics/checkpoint phases)
+                            jax.block_until_ready((params, losses))
+                            ts1 = time.perf_counter()
+                            step_compiled = _probe_count() > probes_before
+                            pname = ("step_compile" if step_compiled
+                                     else "step")
+                            stats.add(pname, ts1 - ts0)
+                            self._tracer.record(
+                                f"train/{pname}", ts0, ts1,
+                                parent=self._tracer.current(),
+                                args={"epoch": it})
                         loss_by_it[it] = jnp.mean(losses)
                         ran += 1
                         needs_loss_val = (self.halt_on_nan or self.verbose
                                           or self.loss_callback is not None)
-                        loss_val = (float(loss_by_it[it])  # ONE device sync
-                                    if needs_loss_val else None)
-                        if self.halt_on_nan and not np.isfinite(loss_val):
-                            logger.error(
-                                "non-finite loss %r at epoch %d: halting "
-                                "(halt_on_nan=True); check the learning "
-                                "rate / input data, or resume from the "
-                                "last finite checkpoint", loss_val, it)
-                            nan_halted = True
-                            preempted = True  # reuse the clean-stop path
-                            break
-                        if self.verbose or self.loss_callback is not None:
-                            if self.verbose:
-                                logger.info("iteration %d loss %f", it, loss_val)
-                            self.metrics.scalar("train/loss", loss_val, step=it)
-                            if self.loss_callback is not None:
-                                # reference signature: loss_callback(loss,
-                                # iteration, partition_id) —
-                                # HogwildSparkModel.py:99-100; one logical
-                                # partition here.
-                                self.loss_callback(loss_val, it, 0)
+                        with (stats.phase("metrics") if stats is not None
+                              else contextlib.nullcontext()):
+                            loss_val = (float(loss_by_it[it])  # ONE device sync
+                                        if needs_loss_val else None)
+                            if self.halt_on_nan and not np.isfinite(loss_val):
+                                logger.error(
+                                    "non-finite loss %r at epoch %d: halting "
+                                    "(halt_on_nan=True); check the learning "
+                                    "rate / input data, or resume from the "
+                                    "last finite checkpoint", loss_val, it)
+                                nan_halted = True
+                                preempted = True  # reuse the clean-stop path
+                                break
+                            if self.verbose or self.loss_callback is not None:
+                                if self.verbose:
+                                    logger.info("iteration %d loss %f", it,
+                                                loss_val)
+                                self.metrics.scalar("train/loss", loss_val,
+                                                    step=it)
+                                if self.loss_callback is not None:
+                                    # reference signature: loss_callback(loss,
+                                    # iteration, partition_id) —
+                                    # HogwildSparkModel.py:99-100; one logical
+                                    # partition here.
+                                    self.loss_callback(loss_val, it, 0)
                         if self.straggler_factor:
                             jax.block_until_ready(loss_by_it[it])
                             secs = time.perf_counter() - te
@@ -931,10 +1039,16 @@ class Trainer:
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and (it % self.checkpoint_every == 0
                                      or it == total_epochs)):
-                            ckpt_mgr.save(
-                                it, _ckpt_state(params,
-                                                self._opt_to_ckpt(params, opt_state),
-                                                it, rng, rng_impl=self.rng_impl))
+                            with (stats.phase("checkpoint")
+                                  if stats is not None
+                                  else contextlib.nullcontext()):
+                                ckpt_mgr.save(
+                                    it, _ckpt_state(
+                                        params,
+                                        self._opt_to_ckpt(params, opt_state),
+                                        it, rng, rng_impl=self.rng_impl))
+                        if stats is not None:
+                            stats.end_step(compiled=step_compiled)
                     if preempted:
                         break
                 break
@@ -964,6 +1078,25 @@ class Trainer:
         # block until the last step is done for honest timing
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
+        if stats is not None:
+            # FLOPs per "step" (= one epoch_fn call = num_batches optimizer
+            # steps) via XLA cost analysis; best-effort — it compiles a
+            # probe step (clock stopped first so that compile doesn't
+            # inflate the fit's wall time), and some strategies/backends
+            # can't price it
+            stats.stop_clock()
+            flops = None
+            if not multi:
+                try:
+                    from .utils.flops import train_step_flops
+                    per_batch = train_step_flops(
+                        self.model, self.input_name, self.label_name,
+                        self.optimizer, x_pad[:batch], y_pad[:batch])
+                    if per_batch:
+                        flops = per_batch * num_batches
+                except Exception:
+                    flops = None
+            stats.finalize(flops_per_step=flops)
         # real examples per epoch: padded rows carry zero weight and don't
         # count; stochastic mode counts sampled slots (its actual step volume)
         per_epoch = num_batches * batch if mode == "stochastic" else n

@@ -7,6 +7,21 @@ recording is a dict update; device syncs only happen where the caller already
 has a value. Histograms (``observe``/``percentile``) back the serving-side
 latency metrics (p50/p95/p99) and are bounded by a reservoir cap so a
 long-lived server never grows without limit.
+
+Four value kinds, four write paths:
+
+- ``scalar(name, v, step)`` — a time series (loss curves); every point kept.
+- ``incr(name)``            — a monotone counter (requests served).
+- ``gauge(name, v)``        — last-value-wins (queue depth, memory in use);
+                              no history, one float per name.
+- ``observe(name, v)``      — a distribution (latencies); reservoir-sampled.
+
+Serving handlers record from many threads, so every read-modify-write —
+including ``scalar``'s default-step computation and the listener snapshot —
+happens under one registry lock. Listeners themselves are invoked *outside*
+the lock (a listener that records back into the registry must not deadlock).
+Prometheus text exposition of the whole registry lives in
+:mod:`sparkflow_tpu.obs.exporters`.
 """
 
 from __future__ import annotations
@@ -16,7 +31,7 @@ import random
 import threading
 import time
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 # Per-histogram sample cap. Beyond it, reservoir sampling keeps a uniform
 # sample of the whole stream (percentiles stay unbiased) instead of the
@@ -64,7 +79,7 @@ class _Histogram:
         return s[lo] * (1.0 - frac) + s[hi] * frac
 
     def summary(self) -> Dict[str, float]:
-        return {"count": self.count,
+        return {"count": self.count, "sum": self.total,
                 "mean": self.total / self.count if self.count else 0.0,
                 "min": self.vmin, "max": self.vmax,
                 "p50": self.percentile(50), "p95": self.percentile(95),
@@ -75,28 +90,41 @@ class Metrics:
     def __init__(self):
         self._scalars: Dict[str, List[tuple]] = defaultdict(list)
         self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, Tuple[float, float]] = {}  # name -> (v, ts)
         self._hists: Dict[str, _Histogram] = {}
         self._listeners: List[Callable[[str, float, int], None]] = []
-        # serving handlers record from many threads; counter += and
-        # histogram reservoir updates are read-modify-write, so both take
-        # the lock (list.append in scalar() is atomic and stays lock-free)
-        self._hist_lock = threading.Lock()
+        self._lock = threading.Lock()
 
     def scalar(self, name: str, value: float, step: Optional[int] = None) -> None:
-        step = step if step is not None else len(self._scalars[name])
-        self._scalars[name].append((step, float(value), time.time()))
-        for fn in self._listeners:
-            fn(name, float(value), step)
+        value = float(value)
+        with self._lock:
+            # the default step is "next index in this series" — a
+            # read-modify-write that must not race with another recorder
+            if step is None:
+                step = len(self._scalars[name])
+            self._scalars[name].append((step, value, time.time()))
+            listeners = tuple(self._listeners)
+        # fan out outside the lock: a listener recording back into this
+        # registry (e.g. mirroring losses into a gauge) must not deadlock
+        for fn in listeners:
+            fn(name, value, step)
 
     def incr(self, name: str, amount: float = 1.0) -> None:
-        with self._hist_lock:
+        with self._lock:
             self._counters[name] += amount
+
+    def gauge(self, name: str, value: float) -> None:
+        """Last-value-wins instantaneous reading (queue depth, bytes in
+        use). Unlike ``scalar`` it keeps no history — the natural shape for
+        sampled state, and what Prometheus expects of a gauge."""
+        with self._lock:
+            self._gauges[name] = (float(value), time.time())
 
     def observe(self, name: str, value: float) -> None:
         """Record one observation into the ``name`` histogram (latencies,
         batch sizes, fill ratios — anything whose distribution matters more
         than its last value)."""
-        with self._hist_lock:
+        with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = _Histogram(seed=len(self._hists))
@@ -104,7 +132,7 @@ class Metrics:
 
     def percentile(self, name: str, q: float) -> float:
         """q-th percentile (q in [0, 100]) of histogram ``name``."""
-        with self._hist_lock:
+        with self._lock:
             if name not in self._hists:
                 raise KeyError(f"no histogram named {name!r}")
             return self._hists[name].percentile(q)
@@ -115,45 +143,70 @@ class Metrics:
         return {f"p{g:g}": self.percentile(name, g) for g in qs}
 
     def subscribe(self, fn: Callable[[str, float, int], None]) -> None:
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def series(self, name: str) -> List[tuple]:
-        return list(self._scalars.get(name, []))
+        with self._lock:
+            return list(self._scalars.get(name, []))
 
     def counters(self) -> Dict[str, float]:
-        return dict(self._counters)
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return {name: v for name, (v, _) in self._gauges.items()}
 
     def histograms(self) -> Dict[str, Dict[str, float]]:
-        with self._hist_lock:
+        with self._lock:
             return {name: h.summary() for name, h in self._hists.items()
                     if h.count}
 
+    def _snapshot(self):
+        """One consistent view of every table (single lock acquisition, so
+        summary/JSONL export can't interleave with concurrent recorders)."""
+        with self._lock:
+            scalars = {name: list(pts) for name, pts in self._scalars.items()}
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {name: h.summary() for name, h in self._hists.items()
+                     if h.count}
+        return scalars, counters, gauges, hists
+
     def summary(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {"counters": self.counters()}
-        for name, pts in self._scalars.items():
+        scalars, counters, gauges, hists = self._snapshot()
+        out: Dict[str, Any] = {"counters": counters}
+        for name, pts in scalars.items():
             vals = [v for _, v, _ in pts]
             out[name] = {"last": vals[-1], "min": min(vals), "max": max(vals),
                          "count": len(vals)}
-        hists = self.histograms()
+        if gauges:
+            out["gauges"] = {name: v for name, (v, _) in gauges.items()}
         if hists:
             out["histograms"] = hists
         return out
 
     def dump_jsonl(self, path: str) -> None:
+        scalars, counters, gauges, hists = self._snapshot()
         with open(path, "w") as f:
-            for name, pts in self._scalars.items():
+            for name, pts in scalars.items():
                 for step, value, ts in pts:
                     f.write(json.dumps({"name": name, "step": step,
                                         "value": value, "ts": ts}) + "\n")
-            for name, value in self._counters.items():
+            for name, value in counters.items():
                 f.write(json.dumps({"name": name, "counter": value}) + "\n")
-            for name, hist in self.histograms().items():
+            for name, (value, ts) in gauges.items():
+                f.write(json.dumps({"name": name, "gauge": value,
+                                    "ts": ts}) + "\n")
+            for name, hist in hists.items():
                 f.write(json.dumps({"name": name, "histogram": hist}) + "\n")
 
     def reset(self) -> None:
-        self._scalars.clear()
-        self._counters.clear()
-        with self._hist_lock:
+        with self._lock:
+            self._scalars.clear()
+            self._counters.clear()
+            self._gauges.clear()
             self._hists.clear()
 
 

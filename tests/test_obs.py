@@ -1,0 +1,496 @@
+"""Observability layer: spans, step stats, Prometheus exposition, request
+tracing through serving, and the memory watcher.
+
+Covers the PR's acceptance criteria directly: span nesting and cross-thread
+parent propagation, Chrome-trace schema validity, Prometheus text that a
+scraper can parse (typed metrics, histogram quantiles), traced-fit phase
+sums accounting for the wall clock with compile separated from steady
+steps, request-id round-trip through the HTTP front, and memory-watcher
+start/stop idempotence.
+"""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.obs import (MemoryWatcher, StepStats, Tracer,
+                               current_tracer, prometheus_name,
+                               prometheus_text, span)
+from sparkflow_tpu.trainer import Trainer
+from sparkflow_tpu.utils.metrics import Metrics
+
+
+# -- spans -------------------------------------------------------------------
+
+def test_span_nesting_single_thread():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        with tr.span("mid") as mid:
+            with tr.span("inner") as inner:
+                pass
+    spans = {s.name: s for s in tr.spans()}
+    assert set(spans) == {"outer", "mid", "inner"}
+    assert spans["outer"].parent_id is None
+    assert spans["mid"].parent_id == outer.span_id
+    assert spans["inner"].parent_id == mid.span_id
+    # completion order: innermost commits first
+    assert [s.name for s in tr.spans()] == ["inner", "mid", "outer"]
+    for s in spans.values():
+        assert s.t1 is not None and s.t1 >= s.t0
+
+
+def test_span_sibling_parents_dont_leak():
+    tr = Tracer()
+    with tr.span("root") as root:
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+    by_name = {s.name: s for s in tr.spans()}
+    assert by_name["a"].parent_id == root.span_id
+    assert by_name["b"].parent_id == root.span_id
+
+
+def test_cross_thread_parent_propagation():
+    tr = Tracer()
+    with tr.span("request") as req:
+        def worker():
+            # a worker thread has its own (empty) stack: nesting does not
+            # cross threads implicitly, only via an explicit parent
+            with tr.span("orphan"):
+                pass
+            with tr.span("child", parent=req):
+                with tr.span("grandchild"):
+                    pass
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by_name = {s.name: s for s in tr.spans()}
+    assert by_name["orphan"].parent_id is None
+    assert by_name["child"].parent_id == req.span_id
+    assert by_name["grandchild"].parent_id == by_name["child"].span_id
+    assert by_name["child"].tid != by_name["request"].tid
+
+
+def test_record_posthoc_span():
+    tr = Tracer()
+    t0 = time.perf_counter()
+    t1 = t0 + 0.25
+    sp = tr.record("queue_wait", t0, t1, parent=7, args={"request_id": "r1"})
+    assert sp.parent_id == 7
+    assert abs(sp.duration_s - 0.25) < 1e-9
+    assert tr.spans()[0].args == {"request_id": "r1"}
+
+
+def test_ring_bound_and_dropped():
+    tr = Tracer(max_spans=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    assert [s.name for s in tr.spans()] == ["s6", "s7", "s8", "s9"]
+    assert tr.dropped() == 6
+    tr.clear()
+    assert tr.spans() == [] and tr.dropped() == 0
+
+
+def test_module_level_span_routes_to_activated_tracer():
+    tr = Tracer()
+    with span("to_default"):
+        pass
+    with tr.activate():
+        assert current_tracer() is tr
+        with span("to_tr"):
+            pass
+        inner = Tracer()
+        with inner.activate():
+            with span("to_inner"):
+                pass
+        with span("back_to_tr"):
+            pass
+    assert [s.name for s in tr.spans()] == ["to_tr", "back_to_tr"]
+    assert [s.name for s in inner.spans()] == ["to_inner"]
+    from sparkflow_tpu.obs.spans import default_tracer
+    assert "to_default" in [s.name for s in default_tracer.spans()]
+
+
+def test_activation_is_thread_local():
+    tr = Tracer()
+    seen = []
+
+    def worker():
+        seen.append(current_tracer() is tr)
+
+    with tr.activate():
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen == [False]  # the worker thread never saw the activation
+
+
+def test_chrome_trace_schema(tmp_path):
+    tr = Tracer()
+    with tr.span("parent", args={"k": 1}):
+        with tr.span("child"):
+            pass
+    path = str(tmp_path / "trace.json")
+    assert tr.export_chrome_trace(path) == path
+    with open(path) as f:
+        doc = json.load(f)  # must be valid JSON end-to-end
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert len(events) == len(meta) + len(complete)
+    assert any(e["name"] == "process_name" for e in meta)
+    assert any(e["name"] == "thread_name" for e in meta)
+    for e in complete:
+        # chrome://tracing requires these keys; ts/dur are microseconds
+        for key in ("name", "cat", "ts", "dur", "pid", "tid", "args"):
+            assert key in e, f"{key} missing from {e}"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert "span_id" in e["args"]
+    child = next(e for e in complete if e["name"] == "child")
+    parent = next(e for e in complete if e["name"] == "parent")
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    # child interval nested within the parent interval
+    assert child["ts"] >= parent["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+
+
+def test_jsonl_export(tmp_path):
+    tr = Tracer()
+    with tr.span("a", args={"n": 3}):
+        pass
+    path = str(tmp_path / "spans.jsonl")
+    tr.export_jsonl(path)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["name"] == "a" and rec["args"] == {"n": 3}
+    assert rec["duration_s"] >= 0
+    assert abs(rec["ts"] - time.time()) < 60  # wall-clock, not monotonic
+
+
+def test_span_records_on_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    (sp,) = tr.spans()
+    assert sp.name == "boom" and sp.t1 is not None
+
+
+# -- metrics: gauges + thread-safety ----------------------------------------
+
+def test_gauge_last_value_wins_and_exports():
+    m = Metrics()
+    m.gauge("mem/dev0/bytes_in_use", 100.0)
+    m.gauge("mem/dev0/bytes_in_use", 250.0)
+    assert m.gauges()["mem/dev0/bytes_in_use"] == 250.0
+    assert m.summary()["gauges"]["mem/dev0/bytes_in_use"] == 250.0
+    text = prometheus_text(m)
+    assert "# TYPE mem_dev0_bytes_in_use gauge" in text
+    assert "mem_dev0_bytes_in_use 250.0" in text
+
+
+def test_gauge_in_jsonl_dump(tmp_path):
+    m = Metrics()
+    m.gauge("g", 1.5)
+    m.scalar("loss", 0.5, step=1)
+    path = str(tmp_path / "m.jsonl")
+    m.dump_jsonl(path)
+    recs = [json.loads(l) for l in open(path)]
+    kinds = {("gauge" if "gauge" in r else "scalar") for r in recs}
+    assert kinds == {"gauge", "scalar"}
+    (g,) = [r for r in recs if "gauge" in r]
+    assert g["name"] == "g" and g["gauge"] == 1.5
+
+
+def test_scalar_concurrent_with_listeners():
+    m = Metrics()
+    seen = []
+    lock = threading.Lock()
+
+    def listener(name, value, step):
+        with lock:
+            seen.append((name, step))
+
+    m.subscribe(listener)
+    n_threads, per_thread = 8, 50
+
+    def worker(k):
+        for _ in range(per_thread):
+            m.scalar(f"s{k}", 1.0)  # default step must be race-free per name
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for k in range(n_threads):
+        steps = [s for s, _, _ in m.series(f"s{k}")]
+        assert steps == list(range(per_thread))  # no duplicated default steps
+    assert len(seen) == n_threads * per_thread
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+def test_prometheus_name_sanitization():
+    assert prometheus_name("serving/request_latency_ms") == \
+        "serving_request_latency_ms"
+    assert prometheus_name("train/steps-per.sec") == "train_steps_per_sec"
+    assert prometheus_name("0weird") == "_0weird"
+
+
+def test_prometheus_text_is_parseable():
+    m = Metrics()
+    m.incr("requests", 3)
+    m.gauge("queue_depth", 2.0)
+    m.scalar("loss", 0.125, step=4)
+    for v in range(100):
+        m.observe("latency_ms", float(v))
+    text = prometheus_text(m)
+    assert text.endswith("\n")
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+    for line in text.splitlines():
+        assert line.startswith("#") or line_re.match(line), line
+    # typed families
+    assert "# TYPE requests counter" in text
+    assert "requests 3" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "# TYPE loss gauge" in text
+    assert "loss 0.125" in text
+    # histogram -> summary with quantiles + _sum/_count
+    assert "# TYPE latency_ms summary" in text
+    assert 'latency_ms{quantile="0.5"}' in text
+    assert 'latency_ms{quantile="0.95"}' in text
+    assert 'latency_ms{quantile="0.99"}' in text
+    assert "latency_ms_count 100" in text
+    assert "latency_ms_sum 4950" in text
+
+
+# -- memory watcher ----------------------------------------------------------
+
+def test_memory_watcher_sample_publishes_gauges():
+    m = Metrics()
+    w = MemoryWatcher(metrics=m, interval_s=60.0)
+    w.sample()
+    gauges = m.gauges()
+    mem = {k: v for k, v in gauges.items() if k.startswith("mem/")}
+    assert mem, f"no mem/ gauges published: {sorted(gauges)}"
+    assert all(v >= 0 for v in mem.values())
+
+
+def test_memory_watcher_start_stop_idempotent():
+    w = MemoryWatcher(metrics=Metrics(), interval_s=0.05)
+    assert not w.running
+    w.start()
+    first = w._thread
+    w.start()  # second start: no new thread
+    assert w._thread is first and w.running
+    w.stop()
+    assert not w.running
+    w.stop()  # second stop: no-op, no raise
+    with w:
+        assert w.running
+    assert not w.running
+
+
+# -- step stats through Trainer.fit -----------------------------------------
+
+def clf_graph():
+    x = nn.placeholder([None, 10], name="x")
+    y = nn.placeholder([None, 2], name="y")
+    h = nn.dense(x, 16, activation="relu")
+    out = nn.dense(h, 2, name="out")
+    nn.softmax_cross_entropy(y, out)
+
+
+@pytest.fixture(scope="module")
+def traced_fit(tmp_path_factory):
+    rs = np.random.RandomState(0)
+    X = rs.randn(96, 10).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 96)]
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=5,
+                 mini_batch_size=96)
+    trace = str(tmp_path_factory.mktemp("obs") / "trace.json")
+    t0 = time.perf_counter()
+    res = tr.fit(X, Y, trace_spans=trace)
+    wall = time.perf_counter() - t0
+    return tr, res, trace, wall
+
+
+def test_traced_fit_phase_sums_account_for_wall(traced_fit):
+    tr, res, trace, wall = traced_fit
+    s = tr.last_step_stats
+    assert s is not None
+    phase_sum = sum(s["phase_totals_s"].values())
+    # the breakdown must account for (nearly) all of fit's wall clock:
+    # nothing big left unattributed, nothing double-counted
+    assert 0.80 <= phase_sum / s["wall_s"] <= 1.02, \
+        (phase_sum, s["wall_s"], s["phase_totals_s"])
+    assert s["wall_s"] <= wall * 1.05
+
+
+def test_traced_fit_separates_compile_from_steady_steps(traced_fit):
+    tr, res, trace, wall = traced_fit
+    s = tr.last_step_stats
+    assert s["steps"] == 5
+    assert s["compile_steps"] == 1  # first step compiled, rest steady
+    assert s["phase_counts"]["step_compile"] == 1
+    assert s["phase_counts"]["step"] == 4
+    # compile step costs (much) more than a steady step
+    compile_s = s["phase_totals_s"]["step_compile"]
+    steady_avg = s["phase_totals_s"]["step"] / 4
+    assert compile_s > steady_avg
+    assert s["steps_per_sec"] > 0
+    assert s["examples_per_sec"] > 0
+
+
+def test_traced_fit_chrome_trace_file(traced_fit):
+    tr, res, trace, wall = traced_fit
+    assert tr.last_trace_path == trace
+    with open(trace) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "train/fit" in names
+    assert "train/step_compile" in names
+    assert "train/step" in names
+    assert "train/transfer" in names
+    # the per-step spans nest under train/fit
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    fit = next(e for e in events if e["name"] == "train/fit")
+    steps = [e for e in events if e["name"] == "train/step"]
+    assert all(e["args"].get("parent_id") == fit["args"]["span_id"]
+               for e in steps)
+    # jsonl exported alongside
+    jsonl = trace[: -len(".json")] + ".jsonl"
+    assert any(json.loads(l)["name"] == "train/fit" for l in open(jsonl))
+
+
+def test_untraced_fit_unchanged(traced_fit):
+    # trace_spans defaults off: no tracer attached, fused path untouched
+    rs = np.random.RandomState(1)
+    X = rs.randn(64, 10).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 64)]
+    tr = Trainer(build_graph(clf_graph), "x:0", "y:0", iters=2,
+                 mini_batch_size=64)
+    res = tr.fit(X, Y)
+    assert len(res.losses) == 2
+    assert tr.last_step_stats is None
+    assert tr.last_trace_path is None
+
+
+# -- request tracing through the HTTP front ---------------------------------
+
+IN, OUT = "x:0", "out/BiasAdd:0"
+
+
+def mlp_graph():
+    x = nn.placeholder([None, 4], name="x")
+    h = nn.dense(x, 3, activation="relu")
+    out = nn.dense(h, 2, name="out")
+    nn.mean_squared_error(x, out)
+
+
+@pytest.fixture(scope="module")
+def server():
+    from sparkflow_tpu.serving import InferenceEngine, InferenceServer
+    rs = np.random.RandomState(0)
+    weights = [rs.randn(4, 3).astype(np.float32),
+               rs.randn(3).astype(np.float32),
+               rs.randn(3, 2).astype(np.float32),
+               rs.randn(2).astype(np.float32)]
+    engine = InferenceEngine(build_graph(mlp_graph), weights, input_name=IN,
+                             output_name=OUT, max_batch=8)
+    with InferenceServer(engine, max_delay_ms=1.0,
+                         memory_interval_s=0.1) as srv:
+        yield srv
+
+
+def test_request_id_round_trip(server):
+    from sparkflow_tpu.serving import ServingClient
+    c = ServingClient(server.url)
+    reply = c.predict_full([[0.1, 0.2, 0.3, 0.4]], request_id="my-rid-42")
+    assert reply["request_id"] == "my-rid-42"
+    assert reply["x_request_id_header"] == "my-rid-42"
+    assert np.asarray(reply["predictions"]).shape == (1, 2)
+
+
+def test_request_id_minted_when_absent(server):
+    from sparkflow_tpu.serving import ServingClient
+    c = ServingClient(server.url)
+    r1 = c.predict_full([[0.0] * 4])
+    r2 = c.predict_full([[0.0] * 4])
+    for r in (r1, r2):
+        assert re.fullmatch(r"[0-9a-f]{32}", r["request_id"])
+        assert r["x_request_id_header"] == r["request_id"]
+    assert r1["request_id"] != r2["request_id"]
+
+
+def test_request_latency_decomposition(server):
+    from sparkflow_tpu.serving import ServingClient
+    c = ServingClient(server.url)
+    reply = c.predict_full([[0.5] * 4])
+    t = reply["timing_ms"]
+    assert set(t) == {"queue_wait_ms", "batch_assembly_ms", "compute_ms",
+                      "total_ms"}
+    assert all(v >= 0 for v in t.values())
+    parts = t["queue_wait_ms"] + t["batch_assembly_ms"] + t["compute_ms"]
+    assert parts <= t["total_ms"] * 1.5 + 1.0  # decomposition is coherent
+
+
+def test_request_spans_parented_to_http_request(server):
+    from sparkflow_tpu.serving import ServingClient
+    tracer = server.tracer
+    tracer.clear()
+    ServingClient(server.url).predict_full([[1.0] * 4], request_id="rid-span")
+    deadline = time.time() + 2.0
+    wanted = {"serving/request", "serving/queue_wait", "serving/batch",
+              "serving/engine_compute"}
+    while time.time() < deadline:
+        names = {s.name for s in tracer.spans()}
+        if wanted <= names:
+            break
+        time.sleep(0.01)
+    assert wanted <= {s.name for s in tracer.spans()}
+    by_name = {}
+    for s in tracer.spans():
+        by_name.setdefault(s.name, []).append(s)
+    req = next(s for s in by_name["serving/request"]
+               if (s.args or {}).get("request_id") == "rid-span")
+    waits = [s for s in by_name["serving/queue_wait"]
+             if (s.args or {}).get("request_id") == "rid-span"]
+    assert waits and all(s.parent_id == req.span_id for s in waits)
+
+
+def test_http_prometheus_endpoint(server):
+    from sparkflow_tpu.serving import ServingClient
+    c = ServingClient(server.url)
+    c.predict([[0.1] * 4])  # ensure latency histograms have data
+    text = c.metrics_prometheus()
+    assert 'serving_request_latency_ms{quantile="0.5"}' in text
+    assert "serving_request_latency_ms_count" in text
+    assert "# TYPE serving_queue_wait_ms summary" in text
+    # the JSON endpoint still answers (default format)
+    body = c.metrics()
+    assert body["counters"]["serving/requests"] >= 1
+
+
+def test_http_memory_watcher_publishes(server):
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        if any(k.startswith("mem/") for k in server.metrics.gauges()):
+            break
+        time.sleep(0.05)
+    mem = {k for k in server.metrics.gauges() if k.startswith("mem/")}
+    assert mem, "memory watcher published no mem/ gauges"
+    text = prometheus_text(server.metrics)
+    assert any(line.startswith("mem_") for line in text.splitlines())
