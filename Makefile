@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft obs-smoke span-overhead
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -72,6 +72,12 @@ serve-smoke:
 # coordinator joins, and serving drain (docs/resilience.md)
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+# elastic bounded-staleness DP chaos suite (virtual-time stragglers,
+# preemption, lease expiry) plus the sync-vs-elastic straggler benchmark
+elastic-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
+	JAX_PLATFORMS=cpu python bench.py --elastic-straggler
 
 # graftcheck: sharding / tracing / concurrency lint over the repo's own
 # source + the jaxpr self-check over presets x optimizers (docs/analysis.md)

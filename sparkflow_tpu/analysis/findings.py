@@ -62,6 +62,9 @@ RULES: Dict[str, Tuple[str, str]] = {
     "GC-L302": ("unlocked-rmw",
                 "a read-modify-write (+=, -=, ...) on shared state in a "
                 "lock-owning class runs outside any lock"),
+    "GC-L303": ("unlocked-call-to-locked-helper",
+                "a *_locked method (caller-holds-the-lock convention) is "
+                "called outside any lock block"),
     # runtime guards (GC-R4xx)
     "GC-R401": ("excess-retrace",
                 "a guarded function retraced beyond its budget; the "

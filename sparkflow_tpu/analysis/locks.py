@@ -15,6 +15,14 @@ statically, per class:
 - **GC-L302**: a read-modify-write (``self.y += 1``, or ``self.y[k] += 1``)
   outside any lock block in a lock-owning class — load/modify/store is not
   atomic even under the GIL, so concurrent increments lose updates.
+- **GC-L303**: a ``*_locked`` helper is called outside any lock block —
+  the naming convention promises "caller holds the lock", so an unlocked
+  call site breaks the contract the helper's body relies on.
+
+Methods whose name ends in ``_locked`` are the repo's convention for
+"called with the lock already held" (e.g. an eviction sweep shared by
+several locked entry points). Their bodies are scanned as if inside the
+lock — the enforcement moves to their CALL SITES via GC-L303.
 
 ``__init__`` (and ``__new__``) are exempt: no other thread holds the
 object during construction. Classes that own no lock are skipped entirely
@@ -113,9 +121,11 @@ def _with_holds_lock(stmt: ast.With, locks: Set[str]) -> bool:
     return False
 
 
-def _scan_method(method: ast.AST, locks: Set[str]):
+def _scan_method(method: ast.AST, locks: Set[str],
+                 assume_locked: bool = False):
     """Yield (attr, is_rmw, lineno, locked) for every self-attr write in
-    ``method``, tracking whether a lock-holding ``with`` encloses it."""
+    ``method``, tracking whether a lock-holding ``with`` encloses it.
+    ``assume_locked`` seeds the tracking for ``*_locked`` helpers."""
 
     def walk(stmts, locked: bool):
         for st in stmts:
@@ -143,7 +153,36 @@ def _scan_method(method: ast.AST, locks: Set[str]):
                 yield from walk(st.orelse, locked)
                 yield from walk(st.finalbody, locked)
 
-    yield from walk(method.body, False)
+    yield from walk(method.body, assume_locked)
+
+
+def _scan_calls(method: ast.AST, locks: Set[str], held: Set[str],
+                assume_locked: bool):
+    """Yield (helper_name, lineno, locked) for every ``self.<X>(...)`` call
+    where ``X`` is a ``*_locked`` helper, tracking lock context."""
+
+    def visit(node: ast.AST, locked: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested callback: unknown thread / unknown lock state later
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, False)
+            return
+        if isinstance(node, ast.With):
+            inner = locked or _with_holds_lock(node, locks)
+            for item in node.items:
+                yield from visit(item, locked)
+            for st in node.body:
+                yield from visit(st, inner)
+            return
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr in held:
+                yield (attr, node.lineno, locked)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, locked)
+
+    for st in method.body:
+        yield from visit(st, assume_locked)
 
 
 def _lint_class(cls: ast.ClassDef, path: str) -> List[Finding]:
@@ -152,10 +191,14 @@ def _lint_class(cls: ast.ClassDef, path: str) -> List[Finding]:
         return []
     methods = [n for n in cls.body
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # *_locked naming = "caller holds the lock": bodies scan as locked,
+    # call sites are checked instead (GC-L303)
+    held = {m.name for m in methods if m.name.endswith("_locked")}
     # pass 1: which attributes does this class ever write under a lock?
     guarded: Set[str] = set()
     for m in methods:
-        for attr, _rmw, _line, locked in _scan_method(m, locks):
+        for attr, _rmw, _line, locked in _scan_method(
+                m, locks, assume_locked=m.name in held):
             if locked:
                 guarded.add(attr)
     guarded -= locks
@@ -164,7 +207,17 @@ def _lint_class(cls: ast.ClassDef, path: str) -> List[Finding]:
     for m in methods:
         if m.name in _EXEMPT_METHODS:
             continue
-        for attr, rmw, line, locked in _scan_method(m, locks):
+        assume = m.name in held
+        for name, line, locked in _scan_calls(m, locks, held, assume):
+            if not locked:
+                out.append(Finding(
+                    "GC-L303",
+                    f"{cls.name}.{m.name}() calls self.{name}() outside "
+                    f"any lock block — the _locked suffix promises the "
+                    f"caller holds the lock",
+                    path=path, line=line, source="lock_lint"))
+        for attr, rmw, line, locked in _scan_method(
+                m, locks, assume_locked=assume):
             if locked or attr in locks:
                 continue
             if attr in guarded:

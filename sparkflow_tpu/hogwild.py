@@ -4,11 +4,19 @@ The reference lets users bypass the Estimator and train an RDD of
 ``(features, label)`` pairs directly (``HogwildSparkModel(...).train(rdd)``,
 ``sparkflow/HogwildSparkModel.py:110-143,246-266``; exercised by
 ``tests/dl_runner.py:187-214``). This class keeps that constructor surface —
-including the parameter-server-era arguments — and returns the trained flat
-weight list. There is no server: ``master_url``, ``serverStartup`` and ``port``
-are accepted and ignored (no process to spawn, no fixed 8-second startup sleep
-— an anti-feature per SURVEY.md), and ``stop_server`` is a no-op kept for
-try/except cleanup code written against the reference.
+including the parameter-server-era arguments — and now trains the way the
+name promises: asynchronously, through the bounded-staleness elastic engine
+(``parallel.elastic``). Each RDD partition maps to a replica that pushes
+gradients to a versioned in-process parameter store whenever it finishes a
+mini-batch — the reference's Hogwild loop, with the HTTP hop and the
+unbounded staleness removed. ``master_url``, ``serverStartup`` and ``port``
+are still accepted and ignored (the store is in-process: no server to spawn,
+no fixed 8-second startup sleep — an anti-feature per SURVEY.md), and
+``stop_server`` is a no-op kept for try/except cleanup code written against
+the reference. ``acquire_lock`` is likewise accepted for parity: the store
+ALWAYS serializes updates under its lock — SURVEY.md flags the reference's
+lock-free default as a data-corruption misfeature, so unlocked application
+is not offered.
 
 Also exported under the reference's class name ``HogwildSparkModel``.
 """
@@ -22,7 +30,6 @@ import optax
 
 from .ml_util import handle_features
 from .optimizers import build_optimizer
-from .parallel.mesh import default_mesh
 from .trainer import Trainer
 
 
@@ -33,9 +40,9 @@ class HogwildTrainer:
                  tfInput: Optional[str] = None,
                  tfLabel: Optional[str] = None,
                  optimizer: Any = None,
-                 master_url: Optional[str] = None,   # ignored: no HTTP server
+                 master_url: Optional[str] = None,   # ignored: store is in-process
                  serverStartup: int = 8,             # ignored: nothing to wait for
-                 acquire_lock: bool = False,         # no-op under sync all-reduce
+                 acquire_lock: bool = False,         # store always locks (see module doc)
                  mini_batch: int = -1,
                  mini_stochastic_iters: int = -1,
                  shuffle: bool = True,
@@ -43,7 +50,9 @@ class HogwildTrainer:
                  partition_shuffles: int = 1,
                  loss_callback: Optional[Callable] = None,
                  port: int = 5000,                   # ignored: no port to bind
-                 mesh=None):
+                 mesh=None,
+                 max_staleness: int = 4,
+                 dampening="inverse"):
         if tensorflowGraph is None:
             raise ValueError("tensorflowGraph (JSON graph spec) is required")
         if optimizer is None:
@@ -66,7 +75,9 @@ class HogwildTrainer:
             verbose=verbose,
             loss_callback=loss_callback,
             acquire_lock=acquire_lock,
-            mesh=mesh if mesh is not None else default_mesh(),
+            strategy="elastic_dp",
+            elastic={"max_staleness": max_staleness,
+                     "dampening": dampening},
         )
         self.tfLabel = tfLabel
         self.weights: Optional[List[np.ndarray]] = None
@@ -74,13 +85,29 @@ class HogwildTrainer:
     def train(self, rdd) -> List[np.ndarray]:
         """Train on an RDD (or any iterable) of ``(features, label)`` pairs —
         bare features when unsupervised — and return the flat weight list
-        (reference ``HogwildSparkModel.train``, ``HogwildSparkModel.py:246-269``)."""
+        (reference ``HogwildSparkModel.train``, ``HogwildSparkModel.py:246-269``).
+
+        One replica per RDD partition, like the reference's one async worker
+        per ``foreachPartition`` task (clamped to [1, 8] — beyond that the
+        in-process threads contend instead of overlapping); a plain iterable
+        trains with 4 replicas."""
+        if hasattr(rdd, "getNumPartitions"):
+            replicas = max(1, min(8, int(rdd.getNumPartitions())))
+        else:
+            replicas = 4
+        self._trainer.elastic["replicas"] = replicas
         items = rdd.collect() if hasattr(rdd, "collect") else list(rdd)
         features, labels = handle_features(items,
                                            is_supervised=self.tfLabel is not None)
         self._trainer.fit(features, labels)
         self.weights = self._trainer.weights_list()
         return self.weights
+
+    @property
+    def elastic_stats(self):
+        """Push/staleness/membership accounting from the last ``train``
+        (``ElasticResult.stats``), or None before training."""
+        return self._trainer.last_elastic_stats
 
     def stop_server(self) -> None:
         """No server exists; kept so reference-style cleanup code runs

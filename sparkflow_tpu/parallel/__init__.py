@@ -14,16 +14,27 @@ plus multi-host process groups via ``jax.distributed``, and vmapped
 hyperparameter parallelism (``hyper.hyperparameter_search`` — the reference's
 unshipped "Hyperopt" future-work item, realized as K configs in one XLA
 program). Collectives ride ICI within a slice and DCN across slices; there is
-no parameter server process.
+no parameter server process on the sync paths — and one bounded-staleness
+versioned store (``elastic``, the modernized Hogwild heritage) on the async
+elastic path, where stragglers and preempted replicas delay their own
+contribution instead of stalling the fleet.
 """
 
 from .mesh import default_mesh, make_mesh, mesh_axis_size
 from . import collectives
 from .dp import make_dp_shardmap_train_step, make_dp_zero1_train_step
+from .elastic import (ElasticDPEngine, ElasticParamStore, ElasticResult,
+                      InProcessTransport, PushResult, ReplicaSpec, SparseRows,
+                      decode_grads, encode_grads,
+                      sync_baseline_examples_per_sec)
 from .ep import make_moe_shardmap_train_step, place_moe_params
 from .hyper import HyperResult, hyperparameter_search
 
 __all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives",
            "make_dp_shardmap_train_step", "make_dp_zero1_train_step",
            "make_moe_shardmap_train_step",
-           "place_moe_params", "HyperResult", "hyperparameter_search"]
+           "place_moe_params", "HyperResult", "hyperparameter_search",
+           "ElasticDPEngine", "ElasticParamStore", "ElasticResult",
+           "InProcessTransport", "PushResult", "ReplicaSpec", "SparseRows",
+           "encode_grads", "decode_grads",
+           "sync_baseline_examples_per_sec"]

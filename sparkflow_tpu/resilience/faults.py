@@ -5,7 +5,9 @@ Two complementary mechanisms:
 1. **Named fault points.** Production code calls :func:`fire` at a handful of
    interesting places (``"engine.predict"`` in the serving engine,
    ``"checkpoint.pre_commit"`` between a checkpoint's tmp-dir write and its
-   atomic rename). The call is a no-op dict probe unless a test has armed the
+   atomic rename, ``"elastic.push"`` / ``"elastic.pull"`` around the elastic
+   parameter store's weight/gradient exchange). The call is a no-op dict
+   probe unless a test has armed the
    point via the :func:`inject` context manager — which can raise a chosen
    exception on chosen call indices (or with a seeded probability) and/or
    delay calls, all reproducibly.
@@ -59,7 +61,7 @@ class _FaultSpec:
         self.failures = 0
         self._rng = random.Random(seed)
 
-    def on_call(self) -> None:
+    def on_call(self, sleep=None) -> None:
         with _LOCK:
             i = self.calls
             self.calls += 1
@@ -73,21 +75,25 @@ class _FaultSpec:
             if should_fail:
                 self.failures += 1
         if self.delay_ms > 0:
-            time.sleep(self.delay_ms / 1000.0)
+            (sleep or time.sleep)(self.delay_ms / 1000.0)
         if should_fail:
             exc = self.exc
             raise (exc(f"injected fault at {self.point!r} (call {i})")
                    if isinstance(exc, type) else exc)
 
 
-def fire(point: str) -> None:
+def fire(point: str, *, sleep=None) -> None:
     """Fault-point hook for production code: no-op unless a test armed
-    ``point`` via :func:`inject` (then it may delay and/or raise)."""
+    ``point`` via :func:`inject` (then it may delay and/or raise).
+
+    ``sleep`` overrides how an injected ``delay_ms`` waits — virtual-time
+    harnesses (``parallel.elastic``'s simulated clock) pass an advance
+    function so delays cost simulated, not real, seconds."""
     if not _ACTIVE:  # fast path: nothing armed anywhere
         return
     spec = _ACTIVE.get(point)
     if spec is not None:
-        spec.on_call()
+        spec.on_call(sleep)
 
 
 @contextmanager

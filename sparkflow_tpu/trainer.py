@@ -136,7 +136,9 @@ class Trainer:
                  pp_microbatches: Optional[int] = None,
                  pp_schedule: str = "gpipe",
                  weight_update_sharding: str = "auto",
-                 debug_recompiles: bool = False):
+                 debug_recompiles: bool = False,
+                 strategy: Optional[str] = None,
+                 elastic: Optional[Dict[str, Any]] = None):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -198,6 +200,29 @@ class Trainer:
                 f"weight_update_sharding must be 'auto', 'on', or 'off'; "
                 f"got {weight_update_sharding!r}")
         self.weight_update_sharding = weight_update_sharding
+        # training strategy: None/'sync' is the synchronous mesh path below;
+        # 'elastic_dp' routes fit() through parallel.elastic — bounded-
+        # staleness async replicas over a versioned parameter store (the
+        # reference's Hogwild identity, modernized). `elastic` tunes it:
+        # replicas, max_staleness, dampening, density_threshold, lease_ttl_s.
+        if strategy not in (None, "sync", "elastic_dp"):
+            raise ValueError(
+                f"strategy must be None, 'sync', or 'elastic_dp'; "
+                f"got {strategy!r}")
+        self.strategy = strategy
+        self.elastic = dict(elastic or {})
+        _known = {"replicas", "max_staleness", "dampening",
+                  "density_threshold", "lease_ttl_s"}
+        unknown = set(self.elastic) - _known
+        if unknown:
+            raise ValueError(
+                f"unknown elastic option(s) {sorted(unknown)}; "
+                f"known: {sorted(_known)}")
+        if self.elastic and strategy != "elastic_dp":
+            raise ValueError(
+                "elastic options require strategy='elastic_dp'")
+        # filled by an elastic fit: push/staleness/membership accounting
+        self.last_elastic_stats: Optional[Dict[str, Any]] = None
         # debug_recompiles=True runs each fit under analysis.track_recompiles:
         # every train/epoch-step trace is counted and diffed, and the report
         # lands in self.recompile_report / self.recompile_findings
@@ -708,6 +733,10 @@ class Trainer:
             if labels.ndim == 1:
                 labels = labels[:, None]
 
+        if self.strategy == "elastic_dp":
+            return self._fit_elastic(features, labels, init_params,
+                                     multi=multi)
+
         strategy = self._mesh_strategy()
         task = self._strategy_task(strategy) if strategy != "default" else None
         if strategy != "default":
@@ -1114,6 +1143,80 @@ class Trainer:
                 else "preempted" if preempted else "completed")
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall,
                            stop_reason=stop)
+
+    def _fit_elastic(self, features, labels, init_params,
+                     multi: bool) -> TrainResult:
+        """strategy='elastic_dp': train through
+        :class:`~sparkflow_tpu.parallel.elastic.ElasticDPEngine` — N replica
+        threads over round-robin data shards, exchanging gradients through
+        the bounded-staleness versioned store instead of a sync all-reduce.
+        Reference semantics preserved: per-replica batch is miniBatchSize
+        and each replica makes ``iters`` passes over its shard per shuffle
+        round, like the reference's per-partition workers."""
+        if multi:
+            raise ValueError(
+                "strategy='elastic_dp' supports single-input models only "
+                "(multi-input gradient exchange is not implemented); use "
+                "the sync path")
+        if self.checkpoint_dir:
+            logger.warning(
+                "strategy='elastic_dp' ignores checkpoint_dir: the async "
+                "store has no epoch boundary to checkpoint at (resume "
+                "support is a sync-path feature)")
+
+        from .core import make_loss_fn
+        from .parallel.elastic import ElasticDPEngine
+
+        n = features.shape[0]
+        replicas = int(self.elastic.get("replicas", 4))
+        if replicas < 1:
+            raise ValueError(f"elastic replicas must be >= 1, got {replicas}")
+        replicas = min(replicas, n)  # every replica needs at least one row
+
+        rng = self._make_rng()
+        init_rng, _rng = jax.random.split(rng)
+        if init_params is not None:
+            params = jax.tree.map(lambda a: jnp.array(a), init_params)
+        else:
+            params = self.model.init(init_rng)
+
+        # engine calls back as (loss, replica_step, replica_index) — the
+        # same shape as the sync path's (loss, iteration, partition_id)
+        engine = ElasticDPEngine(
+            make_loss_fn(self.model, self.input_name, self.label_name),
+            self.optimizer, params,
+            max_staleness=int(self.elastic.get("max_staleness", 4)),
+            dampening=self.elastic.get("dampening", "inverse"),
+            density_threshold=self.elastic.get("density_threshold", 0.25),
+            lease_ttl_s=float(self.elastic.get("lease_ttl_s", 10.0)),
+            metrics=self.metrics, loss_callback=self.loss_callback)
+
+        shards = [(features[i::replicas],
+                   labels[i::replicas] if labels is not None else None)
+                  for i in range(replicas)]
+        # mini_batch_size <= 0 means full-batch (the sync planner's 'full'
+        # mode); per replica that is its whole shard per step
+        bs = self.mini_batch_size
+        if bs is None or bs <= 0:
+            bs = n
+        epochs = max(1, self.iters) * self.partition_shuffles
+        result = engine.run_threads(
+            shards, epochs=epochs, batch_size=bs, seed=self.seed)
+
+        self.params = result.params
+        self._last_opt_state = result.opt_state
+        self.last_elastic_stats = result.stats
+        if self.verbose:
+            logger.info(
+                "elastic fit: %d replicas, %d accepted / %d rejected-stale "
+                "/ %d dropped pushes, final version %d",
+                replicas, result.stats["accepted"],
+                result.stats["rejected_stale"],
+                result.stats["dropped_stale"] + result.stats["dropped_fault"],
+                result.version)
+        return TrainResult(result.params, result.losses,
+                           result.examples_per_sec, result.wall_s,
+                           stop_reason="completed")
 
     def ema_weights(self):
         """The debiased Polyak-averaged weight tree from the last fit, when

@@ -308,6 +308,34 @@ def test_l301_l302_detected():
     assert "self.hits" in by_rule["GC-L302"].message
 
 
+def test_locked_suffix_helper_convention():
+    # a *_locked helper's body scans as lock-held (no L301/L302 inside it);
+    # the enforcement moves to call sites: locked call clean, unlocked call
+    # flagged as GC-L303
+    src = textwrap.dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.evictions = 0
+
+            def _sweep_locked(self):
+                self.evictions += 1   # fine: caller holds the lock
+
+            def tick(self):
+                with self._lock:
+                    self._sweep_locked()
+
+            def broken(self):
+                self._sweep_locked()  # GC-L303: no lock held
+    """)
+    fs = locks.lint_source(src)
+    assert rules_of(fs) == {"GC-L303"}
+    (f,) = fs
+    assert "broken" in f.message and "_sweep_locked" in f.message
+
+
 def test_lock_free_class_and_init_exempt():
     # no lock attribute -> the class never opted into the rules; and
     # __init__ writes are exempt even in lock-owning classes
