@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -67,6 +67,13 @@ serve-smoke:
 	assert p.shape == (3, 2), p.shape; \
 	srv.stop(); \
 	print('serve-smoke OK: 3x2 prediction served at', srv.url)"
+
+# fleet chaos smoke: the router test suite, then 3 real replica processes
+# behind a RouterServer with a SIGKILL + same-port restart mid-burst —
+# zero client-visible failures required (docs/serving.md)
+fleet-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/fleet_smoke.py
 
 # chaos suite: deterministic fault injection against checkpoints, resume,
 # coordinator joins, and serving drain (docs/resilience.md)

@@ -6,7 +6,9 @@ Two complementary mechanisms:
    interesting places (``"engine.predict"`` in the serving engine,
    ``"checkpoint.pre_commit"`` between a checkpoint's tmp-dir write and its
    atomic rename, ``"elastic.push"`` / ``"elastic.pull"`` around the elastic
-   parameter store's weight/gradient exchange). The call is a no-op dict
+   parameter store's weight/gradient exchange, ``"router.dispatch"`` /
+   ``"replica.predict"`` around the serving router's admission and its
+   per-replica forwarding attempts). The call is a no-op dict
    probe unless a test has armed the
    point via the :func:`inject` context manager — which can raise a chosen
    exception on chosen call indices (or with a seeded probability) and/or

@@ -25,14 +25,29 @@ users") requires:
   ``Retry-After`` (:class:`~sparkflow_tpu.serving.batcher.Draining`), and
   the client retries 503s/connection errors with jittered backoff.
 
+- :class:`~sparkflow_tpu.serving.router.RouterServer` /
+  :class:`~sparkflow_tpu.serving.membership.Membership` — the fleet layer:
+  N replicas behind one router doing health-gated membership (periodic
+  ``/healthz`` probes + per-replica circuit breakers with half-open
+  recovery), least-loaded dispatch, token-bucket admission and in-flight
+  shedding on the same ``queue_full`` 503 path, retry/reroute around dead
+  or draining replicas, opt-in hedged requests with loser cancellation,
+  and an opt-in content-addressed result cache. Same wire protocol as a
+  single replica, so clients point at a fleet unchanged.
+
 See ``docs/serving.md``, ``docs/resilience.md``, and
-``examples/serving_example.py``.
+``examples/serving_example.py``; ``make fleet-smoke`` chaos-tests the
+router + replicas end to end.
 """
 
 from .batcher import Draining, MicroBatcher, QueueFull
-from .client import ServingClient, ServingError
+from .client import ConnectionPool, ServingClient, ServingError
 from .engine import InferenceEngine
+from .membership import BreakerState, CircuitBreaker, Membership, Replica
+from .router import ResultCache, RouterServer, TokenBucket
 from .server import InferenceServer
 
 __all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
-           "InferenceServer", "ServingClient", "ServingError"]
+           "InferenceServer", "ServingClient", "ServingError",
+           "ConnectionPool", "RouterServer", "Membership", "Replica",
+           "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache"]

@@ -200,6 +200,13 @@ class MicroBatcher:
         with self._lock:
             return self._queued_rows
 
+    def inflight_rows(self) -> int:
+        """Rows in the batch currently on the device — together with
+        :meth:`depth` this is the replica's load signal (``/healthz``
+        exposes both for the router's least-loaded dispatch)."""
+        with self._lock:
+            return self._inflight_rows
+
     # -- worker side ---------------------------------------------------------
 
     def _as_rows(self, x) -> Tuple[np.ndarray, ...]:

@@ -1,27 +1,48 @@
 """Minimal stdlib client for :class:`~sparkflow_tpu.serving.server.InferenceServer`.
 
-Deliberately tiny — ``urllib.request`` plus JSON — because its jobs are the
+Deliberately tiny — ``http.client`` plus JSON — because its jobs are the
 smoke path (``make serve-smoke``), the e2e tests, and showing the wire
 protocol in ~30 lines. Production callers can speak the same JSON from any
 HTTP stack.
+
+Connections are **keep-alive**: the client owns a small pool of persistent
+``HTTPConnection`` objects (:class:`ConnectionPool`), so repeated calls —
+the router's 2 Hz health probes, hedged duplicates, test bursts — pay the
+TCP handshake once, not per request. A request that lands on a stale pooled
+connection (the server restarted, or an idle-timeout closed it) is retried
+once on a fresh one; that retry only covers wire-level "the connection died
+before a response started" signatures, never timeouts, so a slow predict is
+not silently re-executed.
 
 Resilience: :meth:`ServingClient.predict` retries connection errors and
 ``503`` rejections (queue-full backpressure, drains during a rolling
 restart) with jittered exponential backoff, honoring the server's
 ``Retry-After`` hint and a hard wall-clock deadline. ``retries=0`` opts a
-call out entirely (first error propagates untouched).
+call out entirely (first error propagates untouched). Every read path
+accepts a per-request ``timeout_s`` overriding the client-wide timeout, so
+a health probe can be impatient while predictions stay patient.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
 import urllib.error
-import urllib.request
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import urlsplit
 
 import numpy as np
 
 from ..resilience.retry import RetryExhausted, RetryPolicy
+
+# Wire-level failures that mean "this pooled connection is dead" — safe to
+# retry once on a fresh connection because no response ever started.
+# Timeouts are deliberately excluded: the server may be mid-predict.
+_STALE_CONN_ERRORS = (http.client.BadStatusLine,
+                      http.client.RemoteDisconnected,
+                      ConnectionResetError, ConnectionAbortedError,
+                      BrokenPipeError)
 
 
 class ServingError(Exception):
@@ -37,6 +58,61 @@ class ServingError(Exception):
         self.retry_after = retry_after
 
 
+class ConnectionPool:
+    """Bounded stack of idle keep-alive connections to one ``host:port``.
+
+    ``acquire`` pops an idle connection (or dials a new one) — the caller
+    owns it exclusively until ``release``. ``release(conn, reuse=True)``
+    returns it for the next caller; ``reuse=False`` closes it (error paths,
+    ``Connection: close`` responses). The pool holds its lock only around
+    the idle-stack push/pop, never during I/O, so concurrent callers each
+    check out their own connection and proceed in parallel.
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 max_idle: int = 8):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.max_idle = int(max_idle)
+        self._lock = threading.Lock()
+        self._idle: list = []
+        self._closed = False
+
+    def acquire(self, timeout_s: Optional[float] = None
+                ) -> Tuple[http.client.HTTPConnection, bool]:
+        """Returns ``(conn, reused)`` — ``reused`` tells the caller whether
+        a dead-connection error is a stale keep-alive (retry on a fresh
+        one) or a real connect failure (propagate)."""
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        with self._lock:
+            conn = self._idle.pop() if self._idle else None
+        if conn is not None:
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
+            else:
+                conn.timeout = t
+            return conn, True
+        return http.client.HTTPConnection(self.host, self.port, timeout=t), \
+            False
+
+    def release(self, conn: http.client.HTTPConnection,
+                reuse: bool = True) -> None:
+        if reuse:
+            with self._lock:
+                if not self._closed and len(self._idle) < self.max_idle:
+                    self._idle.append(conn)
+                    return
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
+
+
 class ServingClient:
     """``ServingClient(url).predict(rows)`` → np.ndarray of predictions.
 
@@ -50,57 +126,104 @@ class ServingClient:
     """
 
     def __init__(self, url: str, timeout: float = 30.0, retries: int = 3,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 max_idle: int = 8):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.retries = int(retries)
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=self.retries + 1, base_s=0.1, multiplier=2.0,
             max_s=5.0, jitter=0.5, seed=0)
+        parts = urlsplit(self.url)
+        if parts.scheme not in ("http", ""):
+            raise ValueError(f"only http:// urls are supported, got {url!r}")
+        self._pool = ConnectionPool(parts.hostname or "127.0.0.1",
+                                    parts.port or 80, timeout_s=timeout,
+                                    max_idle=max_idle)
+
+    def close(self) -> None:
+        """Drop the pooled keep-alive connections (the server sees clean
+        disconnects instead of idle sockets)."""
+        self._pool.close()
+
+    def __enter__(self) -> "ServingClient":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- wire ----------------------------------------------------------------
+
+    def _http(self, method: str, path: str, body: Optional[bytes],
+              headers: Dict[str, str], timeout_s: Optional[float] = None
+              ) -> Tuple[int, Dict[str, str], bytes]:
+        """One request over a pooled connection; returns
+        ``(status, headers, raw_body)``. A stale pooled connection gets one
+        fresh-connection retry."""
+        for last_try in (False, True):
+            conn, reused = self._pool.acquire(timeout_s)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except _STALE_CONN_ERRORS:
+                self._pool.release(conn, reuse=False)
+                if reused and not last_try:
+                    continue
+                raise
+            except Exception:
+                self._pool.release(conn, reuse=False)
+                raise
+            self._pool.release(conn, reuse=not resp.will_close)
+            return resp.status, {k: v for k, v in resp.getheaders()}, data
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _request(self, path: str, payload: Optional[Dict[str, Any]] = None,
                  headers: Optional[Dict[str, str]] = None,
-                 with_headers: bool = False):
-        req = urllib.request.Request(
-            self.url + path,
-            data=(json.dumps(payload).encode("utf-8")
-                  if payload is not None else None),
-            headers={"Content-Type": "application/json", **(headers or {})},
-            method="POST" if payload is not None else "GET")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                body = json.loads(resp.read().decode("utf-8"))
-                if with_headers:
-                    return body, dict(resp.headers)
-                return body
-        except urllib.error.HTTPError as exc:
-            ra = exc.headers.get("Retry-After") if exc.headers else None
+                 with_headers: bool = False,
+                 timeout_s: Optional[float] = None):
+        status, hdrs, data = self._http(
+            "POST" if payload is not None else "GET", path,
+            (json.dumps(payload).encode("utf-8")
+             if payload is not None else None),
+            {"Content-Type": "application/json", **(headers or {})},
+            timeout_s)
+        if status >= 400:
+            ra = hdrs.get("Retry-After")
             try:
                 retry_after = float(ra) if ra is not None else None
             except ValueError:
                 retry_after = None
             try:
-                err = json.loads(exc.read().decode("utf-8"))["error"]
-                raise ServingError(exc.code, err.get("code", "unknown"),
-                                   err.get("message", ""),
+                err = json.loads(data.decode("utf-8"))["error"]
+                raise ServingError(status, err.get("code", "unknown"),
+                                   err.get("message", ""), retry_after)
+            except (ValueError, KeyError, UnicodeDecodeError):
+                raise ServingError(status, "unknown",
+                                   data.decode("utf-8", "replace")[:200],
                                    retry_after) from None
-            except (ValueError, KeyError):
-                raise ServingError(exc.code, "unknown", str(exc),
-                                   retry_after) from None
+        body = json.loads(data.decode("utf-8"))
+        if with_headers:
+            return body, hdrs
+        return body
 
     @staticmethod
     def _retryable(exc: Exception) -> bool:
         if isinstance(exc, ServingError):
             return exc.status == 503  # queue_full / draining backpressure
-        # URLError covers connection refused/reset and socket timeouts
-        return isinstance(exc, urllib.error.URLError)
+        # connection refused/reset, socket timeouts, torn keep-alives
+        return isinstance(exc, (OSError, http.client.HTTPException,
+                                urllib.error.URLError))
 
-    def predict(self, inputs, retries: Optional[int] = None) -> np.ndarray:
+    def predict(self, inputs, retries: Optional[int] = None,
+                timeout_s: Optional[float] = None) -> np.ndarray:
         """``inputs``: rows (list/array) or, for multi-input engines, a dict
         of ``{input_name: rows}``. Retryable failures (connection errors,
         503) back off and re-send up to ``retries`` times (default: the
         client's setting; 0 = fail fast); anything else — 400s, 500s —
-        raises :class:`ServingError` immediately."""
+        raises :class:`ServingError` immediately. ``timeout_s`` bounds each
+        attempt (default: the client-wide timeout)."""
         if isinstance(inputs, dict):
             wire: Any = {k: np.asarray(v).tolist() for k, v in inputs.items()}
         else:
@@ -112,9 +235,11 @@ class ServingClient:
         attempt = 0
         while True:
             try:
-                reply = self._request("/v1/predict", payload)
+                reply = self._request("/v1/predict", payload,
+                                      timeout_s=timeout_s)
                 return np.asarray(reply["predictions"])
-            except (ServingError, urllib.error.URLError) as e:
+            except (ServingError, OSError,
+                    http.client.HTTPException) as e:
                 attempt += 1
                 if not self._retryable(e) or attempt >= budget:
                     raise
@@ -132,8 +257,8 @@ class ServingClient:
                         e) from e
                 policy.sleep(delay)
 
-    def predict_full(self, inputs,
-                     request_id: Optional[str] = None) -> Dict[str, Any]:
+    def predict_full(self, inputs, request_id: Optional[str] = None,
+                     timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """One attempt (no retries), full reply: ``predictions``, ``rows``,
         the server's ``request_id`` (yours, echoed, if you passed one) and
         the per-request ``timing_ms`` latency decomposition. The echoed
@@ -146,19 +271,22 @@ class ServingClient:
         body, hdrs = self._request(
             "/v1/predict", {"inputs": wire},
             headers=({"X-Request-Id": request_id} if request_id else None),
-            with_headers=True)
+            with_headers=True, timeout_s=timeout_s)
         body["x_request_id_header"] = hdrs.get("X-Request-Id")
         return body
 
-    def healthz(self) -> Dict[str, Any]:
-        return self._request("/healthz")
+    def healthz(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        return self._request("/healthz", timeout_s=timeout_s)
 
-    def metrics(self) -> Dict[str, Any]:
-        return self._request("/metrics")
+    def metrics(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        return self._request("/metrics", timeout_s=timeout_s)
 
-    def metrics_prometheus(self) -> str:
+    def metrics_prometheus(self, timeout_s: Optional[float] = None) -> str:
         """Raw Prometheus text exposition from
         ``GET /metrics?format=prometheus``."""
-        req = urllib.request.Request(self.url + "/metrics?format=prometheus")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read().decode("utf-8")
+        status, _hdrs, data = self._http(
+            "GET", "/metrics?format=prometheus", None, {}, timeout_s)
+        if status >= 400:
+            raise ServingError(status, "unknown",
+                               data.decode("utf-8", "replace")[:200])
+        return data.decode("utf-8")

@@ -1,0 +1,363 @@
+"""Health-gated replica membership for the serving router.
+
+The reference funnels every exchange through one driver-hosted Flask process
+(``sparkflow/HogwildSparkModel.py:156-166``) — a single point of failure the
+paper never mitigates. This module is the fleet-side antidote on the serving
+path: a :class:`Membership` tracks N :class:`Replica` records and decides,
+per request, which replica should get the work. Three independent gates
+compose:
+
+- **Health probes.** A background prober hits each replica's ``/healthz``
+  every ``probe_interval_s``; a 200 marks it healthy and harvests the body's
+  ``queue_depth`` / ``in_flight`` fields as the load signal (the probe
+  doubles as load reporting — no second endpoint). A connection error or a
+  non-200 (a draining replica answers 503) marks it unhealthy.
+- **Circuit breaker** (:class:`CircuitBreaker`), fed by the *data path*:
+  ``failure_threshold`` consecutive dispatch failures eject the replica
+  (OPEN) without waiting for the next probe tick; after ``recovery_s`` one
+  trial request is allowed through (HALF_OPEN) — success closes the
+  breaker, failure re-opens it. DeepSpark's lesson (PAPERS.md, 1602.08191):
+  worker failure is the steady state, so detection has to run at request
+  cadence, not probe cadence.
+- **Drain ejection.** A ``Draining`` 503 from a replica (SIGTERM received,
+  finishing in-flight work) calls :meth:`Membership.eject` — the replica
+  leaves the rotation immediately and re-enters only when its ``/healthz``
+  goes green again (i.e. after a restart).
+
+Dispatch picks the **least-loaded** live replica: lowest router-side
+in-flight counter, tie-broken by the probe-reported replica-side queue
+depth. All mutable state (health flags, counters, load figures) is guarded
+by one ``Membership._lock``; per-replica gauges are published to a
+``utils.metrics`` registry so ``GET /metrics?format=prometheus`` on the
+router exposes the whole fleet (``router/replica<i>/...``).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+from urllib.parse import urlsplit
+
+from ..utils import metrics as metrics_mod
+from .client import ConnectionPool, ServingClient, ServingError
+
+__all__ = ["BreakerState", "CircuitBreaker", "Replica", "Membership"]
+
+logger = logging.getLogger("sparkflow_tpu")
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "closed"          # normal operation
+    OPEN = "open"              # ejected: all requests refused
+    HALF_OPEN = "half_open"    # recovery window: one trial request allowed
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a half-open recovery probe.
+
+    CLOSED counts consecutive failures; at ``failure_threshold`` it OPENs
+    (``allow()`` returns False). After ``recovery_s`` the next ``allow()``
+    claims the single HALF_OPEN trial slot; the trial's ``record_success``
+    closes the breaker, its ``record_failure`` re-opens it for another
+    ``recovery_s``. ``clock`` is injectable so tests drive recovery with a
+    fake clock instead of sleeping.
+    """
+
+    def __init__(self, failure_threshold: int = 3, recovery_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_s = float(recovery_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = BreakerState.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._trial_in_flight = False
+        self.ejections = 0  # times the breaker OPENed (monotone)
+
+    @property
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request be sent now? In HALF_OPEN only one caller wins the
+        trial slot until its outcome is recorded."""
+        with self._lock:
+            if self._state is BreakerState.CLOSED:
+                return True
+            if self._state is BreakerState.OPEN:
+                if self.clock() - self._opened_at < self.recovery_s:
+                    return False
+                self._state = BreakerState.HALF_OPEN
+                self._trial_in_flight = True
+                return True
+            # HALF_OPEN: the trial slot is exclusive
+            if self._trial_in_flight:
+                return False
+            self._trial_in_flight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = BreakerState.CLOSED
+            self._consecutive_failures = 0
+            self._trial_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state is BreakerState.HALF_OPEN:
+                self._open_locked()
+                return
+            self._consecutive_failures += 1
+            if (self._state is BreakerState.CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._open_locked()
+
+    def trip(self) -> None:
+        """Force OPEN immediately (drain ejection: the replica said it is
+        going away; there is no point counting to the threshold)."""
+        with self._lock:
+            if self._state is not BreakerState.OPEN:
+                self._open_locked()
+            else:
+                self._opened_at = self.clock()
+
+    def _open_locked(self) -> None:
+        self._state = BreakerState.OPEN
+        self._opened_at = self.clock()
+        self._consecutive_failures = 0
+        self._trial_in_flight = False
+        self.ejections += 1
+
+
+class Replica:
+    """One backend ``InferenceServer``: address, keep-alive plumbing, breaker,
+    and the load/health figures Membership maintains for it.
+
+    The mutable fields (``healthy``, ``inflight``, ``successes`` ...) are
+    owned by :class:`Membership` and mutated only under its lock; the
+    breaker carries its own lock (it is also poked from dispatch threads).
+    """
+
+    def __init__(self, url: str, index: int, *,
+                 failure_threshold: int = 3, recovery_s: float = 2.0,
+                 probe_timeout_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.url = url.rstrip("/")
+        self.index = index
+        parts = urlsplit(self.url)
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        # data-path pool: dispatch attempts check out abortable connections
+        self.pool = ConnectionPool(self.host, self.port)
+        # probe client: keep-alive too, with retries off (the prober IS the
+        # failure detector; retrying inside it would blur the signal)
+        self.probe_client = ServingClient(self.url, timeout=probe_timeout_s,
+                                          retries=0, max_idle=1)
+        self.breaker = CircuitBreaker(failure_threshold=failure_threshold,
+                                      recovery_s=recovery_s, clock=clock)
+        # -- fields below are guarded by Membership._lock -------------------
+        self.healthy = True          # optimistic until the first probe
+        self.inflight = 0            # router-side dispatches in flight
+        self.queue_depth = 0         # replica-reported, from /healthz
+        self.reported_in_flight = 0  # replica-reported, from /healthz
+        self.successes = 0
+        self.failures = 0
+        self.hedges = 0              # hedge requests sent to this replica
+        self.last_probe_error: Optional[str] = None
+
+    def close(self) -> None:
+        self.pool.close()
+        self.probe_client.close()
+
+
+class Membership:
+    """Thread-safe replica table + health prober + least-loaded picker."""
+
+    def __init__(self, urls: Sequence[str], *,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 failure_threshold: int = 3,
+                 recovery_s: float = 2.0,
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not urls:
+            raise ValueError("at least one replica url is required")
+        self.probe_interval_s = float(probe_interval_s)
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._replicas: List[Replica] = [
+            Replica(u, i, failure_threshold=failure_threshold,
+                    recovery_s=recovery_s, probe_timeout_s=probe_timeout_s,
+                    clock=clock)
+            for i, u in enumerate(urls)]
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Membership":
+        """Probe every replica once synchronously (so the first request
+        already routes on real health), then keep probing on a daemon
+        thread."""
+        if self._prober is not None:
+            return self
+        self.probe_all()
+        self._stop.clear()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="router-prober", daemon=True)
+        self._prober.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5.0)
+            self._prober = None
+        for r in self._replicas:
+            r.close()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self.probe_all()
+
+    # -- probing -------------------------------------------------------------
+
+    def probe_all(self) -> None:
+        for replica in list(self._replicas):
+            self._probe_one(replica)
+        self.publish_gauges()
+
+    def _probe_one(self, replica: Replica) -> None:
+        try:
+            body = replica.probe_client.healthz()
+            ok, err = True, None
+        except ServingError as exc:
+            # 503 = draining (or otherwise not ready): out of rotation, but
+            # the socket is alive — keep probing, it flips back on restart
+            body, ok, err = {}, False, f"http {exc.status} [{exc.code}]"
+        except Exception as exc:  # noqa: BLE001 - any wire failure = down
+            body, ok, err = {}, False, f"{type(exc).__name__}: {exc}"
+        with self._lock:
+            was_healthy = replica.healthy
+            replica.healthy = ok
+            replica.last_probe_error = err
+            if ok:
+                replica.queue_depth = int(body.get("queue_depth", 0))
+                replica.reported_in_flight = int(body.get("in_flight", 0))
+        if ok:
+            # a live /healthz is recovery evidence: without it an ejected
+            # replica on an idle fleet stays OPEN forever, because half-open
+            # trials otherwise only happen on dispatch. allow() paces this to
+            # the breaker's own recovery window and claims the single trial
+            # slot (skipped if a real request already holds it).
+            br = replica.breaker
+            if br.state is not BreakerState.CLOSED and br.allow():
+                br.record_success()
+        if ok != was_healthy:
+            logger.warning("router: replica %s is now %s%s", replica.url,
+                           "healthy" if ok else "unhealthy",
+                           "" if ok else f" ({err})")
+
+    # -- dispatch bookkeeping ------------------------------------------------
+
+    def pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
+        """Least-loaded live replica (healthy + breaker allows), or None.
+        ``exclude`` skips replicas already tried for this request (reroute)
+        or already carrying its primary attempt (hedge)."""
+        skip = set(id(r) for r in exclude)
+        with self._lock:
+            ordered = sorted(
+                (r for r in self._replicas
+                 if id(r) not in skip and r.healthy),
+                key=lambda r: (r.inflight, r.queue_depth, r.index))
+        # breaker.allow() outside the membership lock, in load order, and
+        # ONLY until the first taker: allow() on a HALF_OPEN breaker claims
+        # its single trial slot, so probing replicas we then don't dispatch
+        # to would strand their trial and lock them out
+        for r in ordered:
+            if r.breaker.allow():
+                return r
+        return None
+
+    def begin_dispatch(self, replica: Replica, hedge: bool = False) -> None:
+        with self._lock:
+            replica.inflight += 1
+            if hedge:
+                replica.hedges += 1
+
+    def end_dispatch(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+
+    def record_success(self, replica: Replica) -> None:
+        replica.breaker.record_success()
+        with self._lock:
+            replica.successes += 1
+
+    def record_failure(self, replica: Replica, reason: str = "") -> None:
+        replica.breaker.record_failure()
+        with self._lock:
+            replica.failures += 1
+        if replica.breaker.state is BreakerState.OPEN:
+            logger.warning("router: circuit opened for replica %s%s",
+                           replica.url, f" ({reason})" if reason else "")
+
+    def eject(self, replica: Replica, reason: str = "") -> None:
+        """Immediate removal from rotation (draining replica): trip the
+        breaker AND mark unhealthy — only a green ``/healthz`` re-admits."""
+        replica.breaker.trip()
+        with self._lock:
+            replica.healthy = False
+        logger.warning("router: ejected replica %s%s", replica.url,
+                       f" ({reason})" if reason else "")
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas)
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            candidates = [r for r in self._replicas if r.healthy]
+        return sum(1 for r in candidates
+                   if r.breaker.state is not BreakerState.OPEN)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Per-replica status table for the router's ``/healthz`` body."""
+        with self._lock:
+            rows = [dict(url=r.url, index=r.index, healthy=r.healthy,
+                         inflight=r.inflight, queue_depth=r.queue_depth,
+                         reported_in_flight=r.reported_in_flight,
+                         successes=r.successes, failures=r.failures,
+                         hedges=r.hedges, last_probe_error=r.last_probe_error)
+                    for r in self._replicas]
+        for row, r in zip(rows, self.replicas):
+            row["breaker"] = r.breaker.state.value
+            row["ejections"] = r.breaker.ejections
+        return rows
+
+    def publish_gauges(self) -> None:
+        """Export the fleet table as Prometheus gauges:
+        ``router/replica<i>/{healthy,ejected,inflight,error_rate,hedges}``."""
+        for row in self.snapshot():
+            prefix = f"router/replica{row['index']}"
+            total = row["successes"] + row["failures"]
+            ejected = row["breaker"] != BreakerState.CLOSED.value
+            self.metrics.gauge(f"{prefix}/healthy",
+                               1.0 if row["healthy"] and not ejected else 0.0)
+            self.metrics.gauge(f"{prefix}/ejected", 1.0 if ejected else 0.0)
+            self.metrics.gauge(f"{prefix}/inflight", float(row["inflight"]))
+            self.metrics.gauge(f"{prefix}/error_rate",
+                               row["failures"] / total if total else 0.0)
+            self.metrics.gauge(f"{prefix}/hedges", float(row["hedges"]))

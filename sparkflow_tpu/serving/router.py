@@ -1,0 +1,667 @@
+"""Fleet-scale serving: a health-gated router over N inference replicas.
+
+One :class:`~sparkflow_tpu.serving.server.InferenceServer` dies with one
+SIGKILL — the reference's single driver-hosted HTTP process has the same
+shape of problem (``sparkflow/HogwildSparkModel.py:156-166``). The
+:class:`RouterServer` makes serving survive that: it fronts N replicas with
+
+- **health-gated membership** (:mod:`~sparkflow_tpu.serving.membership`):
+  periodic ``/healthz`` probes plus a per-replica circuit breaker
+  (consecutive-failure ejection, half-open recovery), and immediate ejection
+  on a ``Draining`` 503 (a replica that caught SIGTERM);
+- **least-loaded dispatch** over live router-side in-flight counters,
+  tie-broken by the replica-reported queue depth the health probe carries;
+- **admission control**: a token bucket (``admission_rate``/``burst``) and a
+  router-wide in-flight cap, both shedding onto the same structured
+  ``503 queue_full`` + ``Retry-After`` path replicas already use — clients
+  that retry 503s need no new logic;
+- **retry + reroute**: a failed dispatch (connection error, 5xx, overload)
+  backs off via :class:`~sparkflow_tpu.resilience.retry.RetryPolicy` and
+  reroutes to the next healthy replica, so a mid-burst replica kill is a
+  retry, not a client-visible failure;
+- **hedged requests** (opt-in): when the primary hasn't answered within a
+  p95-derived delay, a duplicate goes to a second replica; first success
+  wins and the loser is cancelled (its connection is closed, unblocking the
+  worker) — the classic tail-latency lever;
+- **content-addressed result cache** (opt-in): an input-hash LRU over
+  successful responses with hit/miss counters — the first step toward the
+  ROADMAP prefix cache.
+
+Observability: ``X-Request-Id`` is minted (or propagated) at the router and
+threaded through to the replica, so one id joins client log, router spans
+(``router/request`` → ``router/dispatch``), and replica spans. ``GET
+/metrics?format=prometheus`` exposes router counters/histograms plus
+per-replica gauges (``router/replica<i>/{healthy,ejected,inflight,
+error_rate,hedges}``). Chaos: :func:`resilience.faults.fire` points
+``router.dispatch`` (admission side) and ``replica.predict`` (every
+forwarding attempt) make the whole fleet path fault-injectable, and
+``make fleet-smoke`` kills/restarts real replica processes under load.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs
+
+from ..obs import spans as spans_mod
+from ..obs.exporters import prometheus_text
+from ..resilience import faults
+from ..resilience.lifecycle import Lifecycle, ServerState
+from ..resilience.retry import RetryPolicy
+from ..utils import metrics as metrics_mod
+from .client import _STALE_CONN_ERRORS
+from .membership import Membership, Replica
+
+__all__ = ["RouterServer", "TokenBucket", "ResultCache"]
+
+
+class TokenBucket:
+    """Token-bucket admission: ``rate`` tokens/s refill up to ``burst``.
+    ``try_acquire`` never blocks — admission control sheds, it does not
+    queue. ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock=time.monotonic):
+        if rate < 0:
+            raise ValueError(f"rate must be >= 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(1.0, rate))
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._last = clock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self.clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class ResultCache:
+    """Content-addressed LRU over successful predict responses.
+
+    Keyed by the hash of the request body (same inputs → same bytes from
+    the same client serialization), valid because the engine is a pure
+    function of its inputs. ``hits``/``misses`` counters are maintained
+    under the cache's own lock.
+    """
+
+    def __init__(self, max_entries: int = 256):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(body: bytes) -> str:
+        return hashlib.sha256(body).hexdigest()
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return dict(value)
+
+    def put(self, key: str, value: Dict[str, Any]) -> None:
+        with self._lock:
+            self._entries[key] = dict(value)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses}
+
+
+class _CallSlot:
+    """Abortable handle on one in-flight replica call — hedging's loser
+    cancellation. ``abort()`` closes the checked-out connection, which
+    unblocks the worker thread mid-``recv`` (HTTP has no cancel verb; the
+    socket teardown is the cancellation)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn = None
+        self.aborted = False
+
+    def attach(self, conn) -> bool:
+        """Register the checked-out connection; False if already aborted
+        (the worker must not even send)."""
+        with self._lock:
+            if self.aborted:
+                return False
+            self._conn = conn
+            return True
+
+    def detach(self) -> None:
+        with self._lock:
+            self._conn = None
+
+    def abort(self) -> None:
+        with self._lock:
+            if self.aborted:
+                return
+            self.aborted = True
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+
+class _Aborted(Exception):
+    """This attempt lost a hedge race; its failure is not the replica's."""
+
+
+class RouterServer:
+    """HTTP router fronting N ``InferenceServer`` replicas.
+
+    ``RouterServer([url1, url2, ...], port=0).start()`` binds an ephemeral
+    port (read ``router.port``/``router.url`` back) and speaks the same wire
+    protocol as a single replica — ``POST /v1/predict``, ``GET /healthz``,
+    ``GET /metrics[?format=prometheus]`` — so :class:`ServingClient` points
+    at a fleet unchanged.
+
+    Parameters (beyond the membership knobs, which forward to
+    :class:`~sparkflow_tpu.serving.membership.Membership`):
+
+    - ``dispatch_retries`` — reroute attempts after the first dispatch
+      fails; ``retry_policy`` shapes the backoff between them.
+    - ``max_inflight`` — router-wide concurrent-request cap; beyond it,
+      requests shed with ``503 queue_full`` + ``Retry-After``.
+    - ``admission_rate`` / ``admission_burst`` — optional token bucket
+      (requests/s); ``None`` disables rate admission.
+    - ``hedge`` / ``hedge_delay_ms`` / ``hedge_floor_ms`` — opt-in hedged
+      requests. With ``hedge_delay_ms=None`` the delay is the live p95 of
+      ``router/request_ms`` (never below ``hedge_floor_ms``).
+    - ``cache_size`` — entries in the content-addressed result cache;
+      0 disables it.
+    """
+
+    def __init__(self, replica_urls: Sequence[str], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 failure_threshold: int = 3,
+                 recovery_s: float = 2.0,
+                 dispatch_retries: int = 3,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 max_inflight: int = 256,
+                 admission_rate: Optional[float] = None,
+                 admission_burst: Optional[float] = None,
+                 hedge: bool = False,
+                 hedge_delay_ms: Optional[float] = None,
+                 hedge_floor_ms: float = 20.0,
+                 cache_size: int = 0,
+                 request_timeout_s: float = 30.0,
+                 retry_after_s: float = 1.0,
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 tracer: Optional[spans_mod.Tracer] = None):
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        self.tracer = (tracer if tracer is not None
+                       else spans_mod.default_tracer)
+        self.membership = Membership(
+            replica_urls, probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            failure_threshold=failure_threshold, recovery_s=recovery_s,
+            metrics=self.metrics)
+        self.dispatch_retries = int(dispatch_retries)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=self.dispatch_retries + 1, base_s=0.05,
+            multiplier=2.0, max_s=0.5, jitter=0.5, seed=0)
+        self.max_inflight = int(max_inflight)
+        self.bucket = (TokenBucket(admission_rate, admission_burst)
+                       if admission_rate is not None else None)
+        self.hedge = bool(hedge)
+        self.hedge_delay_ms = hedge_delay_ms
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.cache = ResultCache(cache_size) if cache_size else None
+        self.request_timeout_s = float(request_timeout_s)
+        self.retry_after_s = float(retry_after_s)
+        self.lifecycle = Lifecycle()
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "RouterServer":
+        if self._thread is not None:
+            return self
+        self.membership.start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="router-server", daemon=True)
+        self._thread.start()
+        self.lifecycle.transition(ServerState.SERVING)
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._thread is None:
+            return
+        self.lifecycle.transition(ServerState.DRAINING)
+        self.lifecycle.wait_idle(timeout)
+        self._httpd.shutdown()
+        self._thread.join(timeout=timeout)
+        self._httpd.server_close()
+        self._thread = None
+        self.membership.stop()
+        self.lifecycle.transition(ServerState.STOPPED)
+
+    def __enter__(self) -> "RouterServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _hedge_delay_s(self) -> float:
+        if self.hedge_delay_ms is not None:
+            return self.hedge_delay_ms / 1000.0
+        try:
+            p95 = self.metrics.percentile("router/request_ms", 95)
+        except (KeyError, ValueError):
+            return self.hedge_floor_ms / 1000.0
+        return max(self.hedge_floor_ms, p95) / 1000.0
+
+    def _call_replica(self, replica: Replica, body: bytes,
+                      headers: Dict[str, str], slot: _CallSlot
+                      ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """One wire exchange with one replica over its keep-alive pool.
+        A stale pooled connection gets one fresh retry (no response had
+        started, so nothing can double-execute)."""
+        for last_try in (False, True):
+            conn, reused = replica.pool.acquire(self.request_timeout_s)
+            if not slot.attach(conn):
+                replica.pool.release(conn, reuse=reused)
+                raise _Aborted()
+            try:
+                conn.request("POST", "/v1/predict", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except _STALE_CONN_ERRORS:
+                aborted = slot.aborted
+                slot.detach()
+                replica.pool.release(conn, reuse=False)
+                if aborted:
+                    raise _Aborted()
+                if reused and not last_try:
+                    continue
+                raise
+            except Exception:
+                aborted = slot.aborted
+                slot.detach()
+                replica.pool.release(conn, reuse=False)
+                if aborted:
+                    raise _Aborted()
+                raise
+            slot.detach()
+            replica.pool.release(conn, reuse=not resp.will_close)
+            obj = json.loads(data.decode("utf-8")) if data else {}
+            if not isinstance(obj, dict):
+                raise ValueError("replica returned a non-object body")
+            return resp.status, obj, {k: v for k, v in resp.getheaders()}
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _run_attempt(self, replica: Replica, body: bytes,
+                     headers: Dict[str, str], slot: _CallSlot,
+                     is_hedge: bool) -> Dict[str, Any]:
+        """One classified dispatch attempt. The outcome dict carries
+        ``ok``/``retryable``/``status``/``obj`` plus breaker bookkeeping
+        side effects (success, failure, or drain ejection)."""
+        self.membership.begin_dispatch(replica, hedge=is_hedge)
+        try:
+            faults.fire("replica.predict")
+            with self.tracer.span("router/dispatch",
+                                  args={"replica": replica.url,
+                                        "hedge": is_hedge}):
+                status, obj, _hdrs = self._call_replica(replica, body,
+                                                        headers, slot)
+        except _Aborted:
+            # lost a hedge race: the closed socket is our doing, not the
+            # replica's — no breaker bookkeeping
+            return {"ok": False, "retryable": False, "aborted": True,
+                    "replica": replica, "hedge": is_hedge}
+        except Exception as exc:  # noqa: BLE001 - wire failure = replica down
+            self.membership.record_failure(replica, type(exc).__name__)
+            return {"ok": False, "retryable": True, "exc": exc,
+                    "replica": replica, "hedge": is_hedge}
+        finally:
+            self.membership.end_dispatch(replica)
+        if status == 200:
+            self.membership.record_success(replica)
+            return {"ok": True, "status": 200, "obj": obj,
+                    "replica": replica, "hedge": is_hedge}
+        code = (obj.get("error") or {}).get("code", "")
+        if status == 503 and code == "draining":
+            # the replica caught SIGTERM: out of rotation NOW, reroute
+            self.membership.eject(replica, "draining 503")
+            return {"ok": False, "retryable": True, "status": status,
+                    "obj": obj, "replica": replica, "hedge": is_hedge}
+        if status == 503:
+            # queue_full: overloaded, not broken — reroute without feeding
+            # the breaker (least-loaded pick already steers away)
+            self.metrics.incr("router/replica_queue_full")
+            return {"ok": False, "retryable": True, "status": status,
+                    "obj": obj, "replica": replica, "hedge": is_hedge}
+        if status >= 500:
+            self.membership.record_failure(replica, f"http {status}")
+            return {"ok": False, "retryable": True, "status": status,
+                    "obj": obj, "replica": replica, "hedge": is_hedge}
+        # 4xx: the request is wrong, not the replica — pass through verbatim
+        return {"ok": False, "retryable": False, "status": status,
+                "obj": obj, "replica": replica, "hedge": is_hedge}
+
+    def _attempt(self, primary: Replica, body: bytes,
+                 headers: Dict[str, str]) -> Dict[str, Any]:
+        """One dispatch round: the primary call, optionally hedged with a
+        duplicate to a second replica after the hedge delay. First success
+        wins; losers are cancelled via their :class:`_CallSlot`."""
+        if not self.hedge:
+            return self._run_attempt(primary, body, headers, _CallSlot(),
+                                     False)
+
+        cond = threading.Condition()
+        outcomes: List[Dict[str, Any]] = []
+        slots: List[_CallSlot] = []
+        launched = [0]
+
+        def run(replica: Replica, is_hedge: bool, slot: _CallSlot) -> None:
+            out = self._run_attempt(replica, body, headers, slot, is_hedge)
+            with cond:
+                outcomes.append(out)
+                cond.notify_all()
+
+        def launch(replica: Replica, is_hedge: bool) -> None:
+            slot = _CallSlot()
+            with cond:
+                slots.append(slot)
+                launched[0] += 1
+            threading.Thread(target=run, args=(replica, is_hedge, slot),
+                             name="router-hedge" if is_hedge
+                             else "router-primary", daemon=True).start()
+
+        launch(primary, False)
+        deadline = time.monotonic() + self.request_timeout_s
+        with cond:
+            cond.wait_for(lambda: outcomes, timeout=self._hedge_delay_s())
+            primary_done = bool(outcomes)
+        if not primary_done:
+            second = self.membership.pick(exclude=[primary])
+            if second is not None:
+                self.metrics.incr("router/hedges")
+                launch(second, True)
+        with cond:
+            cond.wait_for(
+                lambda: any(o["ok"] for o in outcomes)
+                or len(outcomes) >= launched[0],
+                timeout=max(0.0, deadline - time.monotonic()))
+            done = list(outcomes)
+            all_slots = list(slots)
+        winner = next((o for o in done if o["ok"]), None)
+        # cancel losers: every in-flight slot dies with its socket; already
+        # finished attempts see abort() as a no-op on a detached slot
+        for slot in all_slots:
+            slot.abort()
+        if winner is not None:
+            if winner["hedge"]:
+                self.metrics.incr("router/hedge_wins")
+            return winner
+        real = [o for o in done if not o.get("aborted")]
+        if real:
+            # prefer a non-retryable verdict (a 400 is authoritative)
+            return next((o for o in real if not o["retryable"]), real[-1])
+        # nothing answered inside the window: count it against the primary
+        self.membership.record_failure(primary, "timeout")
+        return {"ok": False, "retryable": True,
+                "exc": TimeoutError(f"no replica answered within "
+                                    f"{self.request_timeout_s}s"),
+                "replica": primary, "hedge": False}
+
+    def _dispatch(self, body: bytes, request_id: str
+                  ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """Route one predict request: cache, then retry/reroute rounds."""
+        rid = {"X-Request-Id": request_id}
+        faults.fire("router.dispatch")
+        key = None
+        if self.cache is not None:
+            key = ResultCache.key(body)
+            hit = self.cache.get(key)
+            if hit is not None:
+                self.metrics.incr("router/cache_hits")
+                self.metrics.incr("router/http_200")
+                return 200, {**hit, "request_id": request_id,
+                             "cache": "hit"}, \
+                    {**rid, "X-Cache": "hit"}
+            self.metrics.incr("router/cache_misses")
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": request_id}
+        policy = self.retry_policy
+        start = policy.clock()
+        tried: List[Replica] = []
+        last: Optional[Dict[str, Any]] = None
+        budget = self.dispatch_retries + 1
+        for attempt in range(budget):
+            if attempt:
+                self.metrics.incr("router/rerouted")
+            replica = self.membership.pick(exclude=tried)
+            if replica is None and tried:
+                # every replica already tried this request — start a fresh
+                # pass; a restarted/half-open replica may be back
+                tried = []
+                replica = self.membership.pick()
+            if replica is None:
+                self.metrics.incr("router/no_healthy_replica")
+            else:
+                out = self._attempt(replica, body, headers)
+                if out["ok"]:
+                    obj = out["obj"]
+                    if key is not None and "predictions" in obj:
+                        self.cache.put(key, {
+                            "predictions": obj["predictions"],
+                            "rows": obj.get("rows")})
+                    self.metrics.incr("router/http_200")
+                    return 200, {**obj, "request_id": request_id}, rid
+                if not out["retryable"]:
+                    status = out.get("status", 500)
+                    self.metrics.incr(f"router/http_{status}")
+                    return status, out.get("obj") or {
+                        "error": {"code": "bad_request", "message": ""}}, rid
+                tried.append(replica)
+                last = out
+            if attempt + 1 < budget:
+                delay = policy.backoff(attempt)
+                if policy.clock() - start + delay > self.request_timeout_s:
+                    break
+                policy.sleep(delay)
+        self.metrics.incr("router/http_503")
+        detail = ""
+        if last is not None:
+            exc = last.get("exc")
+            detail = (f"; last error: {type(exc).__name__}: {exc}"
+                      if exc is not None
+                      else f"; last status: {last.get('status')}")
+        return 503, {"error": {
+            "code": "no_healthy_replicas",
+            "message": f"no replica served the request after "
+                       f"{budget} attempt(s){detail}"}}, \
+            {**self._retry_after(), **rid}
+
+    # -- http front ----------------------------------------------------------
+
+    def _retry_after(self) -> Dict[str, str]:
+        return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
+
+    def _predict(self, body: bytes, request_id: str
+                 ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        rid = {"X-Request-Id": request_id}
+        self.metrics.incr("router/requests")
+        # admission: shed BEFORE any replica work, on the same structured
+        # queue_full 503 the replicas use — retrying clients need no new code
+        if self.bucket is not None and not self.bucket.try_acquire():
+            self.metrics.incr("router/admission_rejections")
+            self.metrics.incr("router/http_503")
+            return 503, {"error": {
+                "code": "queue_full",
+                "message": "router admission rate exceeded; retry later"}}, \
+                {**self._retry_after(), **rid}
+        if self.lifecycle.inflight > self.max_inflight:
+            self.metrics.incr("router/shed_inflight")
+            self.metrics.incr("router/http_503")
+            return 503, {"error": {
+                "code": "queue_full",
+                "message": f"router at capacity "
+                           f"({self.max_inflight} in flight)"}}, \
+                {**self._retry_after(), **rid}
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span("router/request",
+                                  args={"request_id": request_id}):
+                status, obj, headers = self._dispatch(body, request_id)
+        except Exception as exc:  # noqa: BLE001 - surface, don't hang
+            self.metrics.incr("router/http_500")
+            return 500, {"error": {"code": "internal",
+                                   "message": f"{type(exc).__name__}: "
+                                              f"{exc}"}}, rid
+        self.metrics.observe("router/request_ms",
+                             (time.perf_counter() - t0) * 1000.0)
+        return status, obj, headers
+
+    def _healthz(self) -> Tuple[int, Dict[str, Any],
+                                Optional[Dict[str, str]]]:
+        state = self.lifecycle.state
+        replicas = self.membership.snapshot()
+        healthy = self.membership.healthy_count()
+        serving = state in (ServerState.SERVING, ServerState.STARTING)
+        body = {"status": ("ok" if serving and healthy else
+                           ("degraded" if serving else state.value)),
+                "state": state.value,
+                "role": "router",
+                "inflight": self.lifecycle.inflight,
+                "healthy_replicas": healthy,
+                "replicas": replicas}
+        if self.cache is not None:
+            body["cache"] = self.cache.stats()
+        if serving and healthy:
+            return 200, body, None
+        return 503, body, self._retry_after()
+
+    def _metrics_json(self) -> Tuple[int, Dict[str, Any]]:
+        self.membership.publish_gauges()
+        summary = self.metrics.summary()
+        if self.cache is not None:
+            summary["cache"] = self.cache.stats()
+        return 200, summary
+
+    def _metrics_prometheus(self) -> Tuple[int, str]:
+        self.membership.publish_gauges()
+        if self.cache is not None:
+            stats = self.cache.stats()
+            self.metrics.gauge("router/cache_entries", stats["entries"])
+        return 200, prometheus_text(self.metrics)
+
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, status: int, obj: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None) -> None:
+                data = json.dumps(obj).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                # same contract as the replica server: once draining, shed
+                # keep-alive connections so clients re-dial elsewhere
+                if router.lifecycle.state not in (ServerState.SERVING,
+                                                  ServerState.STARTING):
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _reply_text(self, status: int, text: str,
+                            content_type: str) -> None:
+                data = text.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._reply(*router._healthz())
+                elif path == "/metrics":
+                    fmt = parse_qs(query).get("format", ["json"])[0]
+                    if fmt == "prometheus":
+                        status, text = router._metrics_prometheus()
+                        self._reply_text(
+                            status, text,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    else:
+                        self._reply(*router._metrics_json())
+                else:
+                    self._reply(404, {"error": {"code": "not_found",
+                                                "message": self.path}})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/predict":
+                    self._reply(404, {"error": {"code": "not_found",
+                                                "message": self.path}})
+                    return
+                request_id = (self.headers.get("X-Request-Id")
+                              or uuid.uuid4().hex)
+                if not router.lifecycle.try_begin_request():
+                    router.metrics.incr("router/http_503")
+                    self._reply(503, {"error": {
+                        "code": "draining",
+                        "message": "router is draining"}},
+                        {**router._retry_after(),
+                         "X-Request-Id": request_id})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    self._reply(*router._predict(body, request_id))
+                finally:
+                    router.lifecycle.end_request()
+
+            def log_message(self, fmt, *args):  # quiet: metrics cover this
+                pass
+
+        return Handler

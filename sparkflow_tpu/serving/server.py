@@ -178,6 +178,23 @@ class InferenceServer:
                 signal_mod.signal(s, prev)
             self._prev_handlers.clear()
 
+    def kill(self) -> None:
+        """Ungraceful stop — the chaos path. Tears the listening socket down
+        NOW: in-flight requests see connection resets, queued batcher work is
+        abandoned with an error. This is what a SIGKILL'd replica looks like
+        to its clients; the fleet tests use it to prove the router reroutes
+        around a corpse (for the graceful path, use :meth:`drain`/:meth:`stop`)."""
+        if self._thread is None:
+            return
+        if self.memory_watcher is not None:
+            self.memory_watcher.stop()
+        self._httpd.shutdown()
+        self._thread.join(timeout=10.0)
+        self._httpd.server_close()
+        self._thread = None
+        self.batcher.close(drain=False, timeout=1.0)
+        self.lifecycle.transition(ServerState.STOPPED)
+
     def __enter__(self):
         return self.start()
 
@@ -263,12 +280,19 @@ class InferenceServer:
         stats = (self.engine.stats()
                  if hasattr(self.engine, "stats") else {})
         state = self.lifecycle.state
+        # queue_depth / in_flight: the health probe doubles as the router's
+        # load signal (least-loaded dispatch reads these — no second
+        # endpoint). inflight/queued_rows stay for older scrapers.
+        queue_depth = self.batcher.depth()
+        in_flight = self.lifecycle.inflight + self.batcher.inflight_rows()
         body = {"status": ("ok" if state in (ServerState.SERVING,
                                              ServerState.STARTING)
                            else state.value),
                 "state": state.value,
                 "inflight": self.lifecycle.inflight,
-                "queued_rows": self.batcher.depth(),
+                "queued_rows": queue_depth,
+                "queue_depth": queue_depth,
+                "in_flight": in_flight,
                 "engine": stats}
         if state in (ServerState.SERVING, ServerState.STARTING):
             return 200, body, None
@@ -296,6 +320,14 @@ class InferenceServer:
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
+                # a draining/stopped server must shed its keep-alive
+                # connections too: otherwise pooled clients (the router's
+                # prober) would keep talking to this dying process instead
+                # of re-dialing — and reaching its restarted successor
+                if server.lifecycle.state not in (ServerState.SERVING,
+                                                  ServerState.STARTING):
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -430,7 +430,7 @@ def test_client_retries_503_until_success(monkeypatch):
     from sparkflow_tpu.serving.client import ServingClient, ServingError
     calls = {"n": 0}
 
-    def fake(self, path, payload=None):
+    def fake(self, path, payload=None, **kw):
         calls["n"] += 1
         if calls["n"] < 3:
             raise ServingError(503, "queue_full", "busy")
@@ -449,7 +449,7 @@ def test_client_honors_retry_after_hint(monkeypatch):
     from sparkflow_tpu.serving.client import ServingClient, ServingError
     calls = {"n": 0}
 
-    def fake(self, path, payload=None):
+    def fake(self, path, payload=None, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             raise ServingError(503, "draining", "drain", retry_after=2.5)
@@ -467,7 +467,7 @@ def test_client_retries_connection_errors(monkeypatch):
     from sparkflow_tpu.serving.client import ServingClient
     calls = {"n": 0}
 
-    def fake(self, path, payload=None):
+    def fake(self, path, payload=None, **kw):
         calls["n"] += 1
         raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
 
@@ -483,7 +483,7 @@ def test_client_retries_zero_opts_out_and_4xx_never_retries(monkeypatch):
     from sparkflow_tpu.serving.client import ServingClient, ServingError
     calls = {"n": 0}
 
-    def fake(self, path, payload=None):
+    def fake(self, path, payload=None, **kw):
         calls["n"] += 1
         raise ServingError(503 if calls["n"] == 1 else 400, "x", "y")
 
@@ -504,7 +504,7 @@ def test_client_deadline_raises_retry_exhausted(monkeypatch):
     from sparkflow_tpu.serving.client import ServingClient, ServingError
     monkeypatch.setattr(
         ServingClient, "_request",
-        lambda self, path, payload=None: (_ for _ in ()).throw(
+        lambda self, path, payload=None, **kw: (_ for _ in ()).throw(
             ServingError(503, "queue_full", "busy")))
     pol = RetryPolicy(max_attempts=10, base_s=1.0, jitter=0.0,
                       deadline_s=0.5, sleep=lambda d: None)
