@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -74,6 +74,13 @@ serve-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/fleet_smoke.py
+
+# decode smoke: the decode test suite, then a real server subprocess
+# serving a mixed-length /v1/generate burst — X-Request-Id echoed on every
+# response, zero steady-state retraces, clean SIGTERM drain (docs/serving.md)
+decode-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/decode_smoke.py
 
 # chaos suite: deterministic fault injection against checkpoints, resume,
 # coordinator joins, and serving drain (docs/resilience.md)

@@ -158,7 +158,7 @@ class _TransformerBase(RegistryModel):
         # to the blockwise/reference paths inside flash_attention
         return flash_attention(q, k, v, causal=causal, kv_mask=mask)
 
-    def _block(self, bp, x, mask, causal, train, rng):
+    def _block(self, bp, x, mask, causal, train, rng, with_kv: bool = False):
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = self._proj(bp, "qkv_", y)
@@ -175,7 +175,29 @@ class _TransformerBase(RegistryModel):
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         y = jax.nn.gelu(self._proj(bp, "fc1_", y))
         y, rng = self._dropout(self._proj(bp, "fc2_", y), train, rng)
+        if with_kv:
+            # prefill path: the block's keys/values ([B,heads,S,d]) feed the
+            # decode KV cache — same tensors attention just consumed
+            return x + y, rng, k, v
         return x + y, rng
+
+    def _block_decode(self, bp, x, layer, cache, pos, attend):
+        """One block applied to a single token ``x`` [B,1,hidden]; attention
+        over the cached history is delegated to ``attend`` (see
+        :meth:`TransformerLM.decode_step`). Same projections/norms/residuals
+        as :meth:`_block` — the architecture is defined once."""
+        b, _, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = self._proj(bp, "qkv_", y)
+        qkv = qkv.reshape(b, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, heads, d]
+        att, cache = attend(layer, q, k, v, cache, pos)
+        att = self._proj(bp, "o_", att.reshape(b, 1, h))
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y = jax.nn.gelu(self._proj(bp, "fc1_", y))
+        y = self._proj(bp, "fc2_", y)
+        return x + y, cache
 
     def _block_aux(self, bp, x, mask, causal, train, rng):
         """Block step that also returns an auxiliary-loss contribution (zero
@@ -276,6 +298,96 @@ class TransformerLM(_TransformerBase):
                             params["embed"]["tok"].T.astype(jnp.float32))
         return {"logits": logits,
                 "pred": jnp.argmax(logits, axis=-1).astype(jnp.float32)}
+
+    # -- autoregressive decode ----------------------------------------------
+    #
+    # The serving decode path (serving/decode.py) drives these; the default
+    # dense cache below is the parity/test implementation, the engine swaps
+    # in a paged `attend` over the shared page pool. Params are untouched —
+    # param_pspecs()'s tp sharding applies to decode exactly as to training.
+
+    def init_decode_cache(self, batch: int, max_len: Optional[int] = None,
+                          dtype=None):
+        """Dense per-slot KV cache ``{"k","v": [layers, B, heads, L, d]}``
+        for the default :meth:`decode_step` attend."""
+        L = int(max_len) if max_len is not None else self.max_len
+        dt = dtype if dtype is not None else self.compute_dtype
+        shape = (self.num_layers, batch, self.num_heads, L, self.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _dense_cache_attend(self, layer, q, k_new, v_new, cache, pos):
+        """Default decode attention: scatter this token's k/v into a dense
+        cache at ``pos`` and attend over positions ``<= pos``. q/k/v are
+        ``[B, heads, d]``; ``pos`` is ``[B]`` int32."""
+        import math as _math
+        b = q.shape[0]
+        L = cache["k"].shape[3]
+        bidx = jnp.arange(b)
+        k = cache["k"][layer].at[bidx, :, pos].set(k_new.astype(cache["k"].dtype))
+        v = cache["v"][layer].at[bidx, :, pos].set(v_new.astype(cache["v"].dtype))
+        scale = 1.0 / _math.sqrt(self.head_dim)
+        s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        valid = jnp.arange(L, dtype=jnp.int32)[None, :] <= pos[:, None]
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhl,bhld->bhd", p, v.astype(jnp.float32))
+        cache = {"k": cache["k"].at[layer].set(k),
+                 "v": cache["v"].at[layer].set(v)}
+        return out.astype(q.dtype), cache
+
+    def decode_step(self, params, cache, token, pos, attend=None):
+        """Single-token autoregressive apply: embed ``token`` [B] int32 at
+        position ``pos`` [B] int32, run every block over the cached history,
+        return ``(logits [B, vocab] f32, cache)``.
+
+        ``attend(layer, q, k_new, v_new, cache, pos) -> (att [B,heads,d],
+        cache)`` owns the KV cache layout; the default uses the dense cache
+        from :meth:`init_decode_cache`, the serving engine passes a paged
+        closure over :func:`~sparkflow_tpu.ops.paged_attention`."""
+        if attend is None:
+            attend = self._dense_cache_attend
+        token = token.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        x = jnp.take(params["embed"]["tok"], token, axis=0)
+        posemb = jnp.take(params["embed"]["pos"],
+                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
+        x = self.cast(x + posemb)[:, None, :]              # [B, 1, hidden]
+        for i in range(self.num_layers):
+            x, cache = self._block_decode(params[f"block_{i}"], x, i, cache,
+                                          pos, attend)
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        logits = jnp.matmul(x[:, 0].astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return logits, cache
+
+    def prefill(self, params, ids, mask=None, lengths=None):
+        """Causal forward over a (padded) prompt that also returns each
+        block's keys/values for the decode cache: ``(logits [B, vocab] at
+        the last valid position, [(k, v)] * layers with k/v [B,heads,S,d])``.
+        ``lengths`` [B] selects the position whose logits seed generation
+        (default: the full row, ``S``)."""
+        ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        x = self.cast(x + params["embed"]["pos"][:s][None, :, :])
+        rng = jax.random.PRNGKey(0)
+        kvs = []
+        for i in range(self.num_layers):
+            x, rng, k, v = self._block(params[f"block_{i}"], x, mask, True,
+                                       False, rng, with_kv=True)
+            kvs.append((k, v))
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        if lengths is None:
+            last = jnp.full((b,), s - 1, jnp.int32)
+        else:
+            last = jnp.clip(lengths.astype(jnp.int32) - 1, 0, s - 1)
+        x_last = x[jnp.arange(b), last]                    # [B, hidden]
+        logits = jnp.matmul(x_last.astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return logits, kvs
 
     def _loss(self, params, feeds, train, rng):
         ids = feeds["input_ids"].astype(jnp.int32)

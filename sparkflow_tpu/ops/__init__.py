@@ -14,8 +14,10 @@ scheduling are hand-written pallas kernels with jnp fallbacks for CPU tests:
   merge exactly via the kernel's saved logsumexp
 """
 
-from .attention import (attention_reference, flash_attention, ring_attention,
-                        ring_flash_attention)
+from .attention import (attention_reference, flash_attention,
+                        paged_attention, paged_attention_reference,
+                        ring_attention, ring_flash_attention)
 
 __all__ = ["flash_attention", "ring_attention", "ring_flash_attention",
-           "attention_reference"]
+           "attention_reference", "paged_attention",
+           "paged_attention_reference"]

@@ -673,6 +673,157 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# Paged attention (single-token decode over a page-table-indirected KV pool)
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
+                              sm_scale: Optional[float] = None):
+    """Ground-truth decode attention over a paged KV pool, pure jnp.
+
+    One query token per slot attends over that slot's cached keys/values,
+    which live scattered across fixed-size pages of a shared pool:
+
+    - ``q``: ``[B, H, D]`` — the current token's query per slot;
+    - ``k_pages`` / ``v_pages``: ``[num_pages, page_size, H, D]`` pool;
+    - ``page_table``: ``[B, max_pages]`` int32 — slot b's cache lives in
+      pages ``page_table[b, :ceil(lengths[b]/page_size)]``, in order
+      (entries past that count must still be valid pool indices — the
+      manager points them at its scratch page);
+    - ``lengths``: ``[B]`` int32 — valid tokens per slot; global position
+      ``p * page_size + t < lengths[b]`` attends, everything else is
+      masked. A slot with ``lengths == 0`` returns exact zeros.
+    """
+    b, h, d = q.shape
+    page = k_pages.shape[1]
+    maxp = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    # gather the slot's whole logical cache: [B, maxp*page, H, D]
+    k = k_pages[page_table].reshape(b, maxp * page, h, d)
+    v = v_pages[page_table].reshape(b, maxp * page, h, d)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(maxp * page, dtype=jnp.int32)
+    valid = pos[None, :] < lengths[:, None]               # [B, K]
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+    # all-masked rows softmax to uniform garbage; empty slots must be zeros
+    out = jnp.where((lengths > 0)[:, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size: int, sm_scale: float):
+    """Grid ``(B, max_pages)``; scalar-prefetched page table drives the
+    K/V BlockSpec index maps, so program ``(b, p)`` sees slot b's p-th
+    logical page already staged in VMEM. Online-softmax state (m, l, acc)
+    folds across the slot's pages; pages at or past ``lengths[b]`` are
+    skipped outright (no flops, state untouched)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    np_ = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [page, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        # s[h, t] = q[h, :] . k[t, h, :]  (batch over H, contract D)
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                                preferred_element_type=jnp.float32) * sm_scale
+        tpos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(tpos < length, s, NEG_INF)          # ragged last page
+        m_prev = m_ref[:]                                 # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pexp = jnp.exp(s - m_new)                         # [H, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(pexp, axis=1, keepdims=True)
+        # acc[h, d] += sum_t pexp[h, t] * v[t, h, d]
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(p == np_ - 1)
+    def _finalize():
+        # empty slot: init state (acc 0, l 0) divides to exact zeros
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths,
+                    sm_scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Decode attention kernel: one query token per slot against a
+    page-table-indirected K/V pool. Same operands/semantics as
+    :func:`paged_attention_reference` (which is its parity ground truth).
+
+    The pallas grid is ``(B, max_pages)`` with the page table and lengths
+    scalar-prefetched (``PrefetchScalarGridSpec``): the BlockSpec index map
+    reads ``page_table[b, p]``, so the gather over scattered pages happens
+    in the pipeline's DMA stage, not as a materialized ``[B, maxp*page]``
+    cache copy the way the reference does it. Pages wholly past a slot's
+    length cost no flops. Falls back to the reference (with the same
+    ``last_attention_path`` reporting) when the head layout violates the
+    TPU tile rules.
+    """
+    b, h, d = q.shape
+    page = k_pages.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    # compiled blocks are [page, H, D]: sublane dim H % 8, lane dim D % 128
+    # (interpret mode has no tile constraint — CPU parity tests run any shape)
+    tiles_ok = (pltpu is not None
+                and (interpret or (h % 8 == 0 and d % 128 == 0)))
+    if not tiles_ok:
+        _LAST_PATH.set("reference")
+        return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                         lengths, sm_scale=scale)
+    _LAST_PATH.set("pallas")
+    maxp = page_table.shape[1]
+    kernel = functools.partial(_paged_kernel, page_size=page, sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxp),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bb, p, t, l: (bb, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda bb, p, t, l: (t[bb, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda bb, p, t, l: (t[bb, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda bb, p, t, l: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),   # acc
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running sum
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        # the page axis folds one slot's online-softmax state — sequential
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 

@@ -35,14 +35,29 @@ users") requires:
   and an opt-in content-addressed result cache. Same wire protocol as a
   single replica, so clients point at a fleet unchanged.
 
+- :class:`~sparkflow_tpu.serving.decode.DecodeEngine` /
+  :class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher` — the
+  autoregressive decode path: a paged KV cache
+  (:class:`~sparkflow_tpu.serving.kvcache.PagedKVCache`, fixed-size pages +
+  per-slot page tables over one preallocated pool, consumed directly by the
+  pallas ``paged_attention`` kernel), AOT-compiled prefill buckets and a
+  fixed-shape decode step that never recompiles, and continuous batching —
+  sequences join and leave the decode batch at token boundaries, so a short
+  completion never waits for a long one. Served as ``POST /v1/generate``
+  (pass the batcher to ``InferenceServer(generate_batcher=...)``) with the
+  same backpressure, drain, and request-id contract as predict.
+
 See ``docs/serving.md``, ``docs/resilience.md``, and
 ``examples/serving_example.py``; ``make fleet-smoke`` chaos-tests the
-router + replicas end to end.
+router + replicas end to end; ``make decode-smoke`` does the same for
+continuous-batching generation.
 """
 
-from .batcher import Draining, MicroBatcher, QueueFull
+from .batcher import ContinuousBatcher, Draining, MicroBatcher, QueueFull
 from .client import ConnectionPool, ServingClient, ServingError
+from .decode import DecodeEngine
 from .engine import InferenceEngine
+from .kvcache import OutOfPages, PagedKVCache
 from .membership import BreakerState, CircuitBreaker, Membership, Replica
 from .router import ResultCache, RouterServer, TokenBucket
 from .server import InferenceServer
@@ -50,4 +65,6 @@ from .server import InferenceServer
 __all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
            "InferenceServer", "ServingClient", "ServingError",
            "ConnectionPool", "RouterServer", "Membership", "Replica",
-           "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache"]
+           "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache",
+           "DecodeEngine", "ContinuousBatcher", "PagedKVCache",
+           "OutOfPages"]

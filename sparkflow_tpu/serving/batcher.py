@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -329,3 +329,335 @@ class MicroBatcher:
                 }
                 p.future.set_result(out[offset:offset + n])
             offset += n
+
+
+class _GenPending:
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
+                 "eos_id", "seed", "future", "enqueued_at", "request_id",
+                 "parent", "prefill_done_at", "slot", "tokens")
+
+    def __init__(self, prompt, max_new_tokens, temperature, top_k, eos_id,
+                 seed, future, enqueued_at, request_id=None, parent=None):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.seed = seed
+        self.future = future
+        self.enqueued_at = enqueued_at
+        self.request_id = request_id
+        self.parent = parent
+        self.prefill_done_at = None
+        self.slot = None
+        self.tokens: List[int] = []
+
+
+class ContinuousBatcher:
+    """Continuous (token-boundary) batching in front of a
+    :class:`~sparkflow_tpu.serving.decode.DecodeEngine`.
+
+    Where :class:`MicroBatcher` coalesces at CALL boundaries — a batch forms,
+    runs once, disperses — generation needs coalescing at TOKEN boundaries:
+    a 2048-token completion and a 10-token one share a decode step per token,
+    and the short one must leave (and its slot be refilled) the moment it
+    finishes, not when the convoy does. The worker loop therefore interleaves
+    three things every iteration: **admit** queued requests into free slots
+    (engine prefill + reservation-based admission), **step** the whole slot
+    batch one token, and **retire** sequences that hit EOS or their token
+    budget — returning pages and the lane to the pool immediately.
+
+    With ``prefill_split=True`` admission/prefill runs on its own worker so a
+    long prompt's prefill never stalls the decode loop; the decode worker
+    keeps stepping whatever is live and picks the new slot up next iteration.
+
+    Backpressure and drain semantics mirror :class:`MicroBatcher` exactly —
+    bounded queue raising :class:`QueueFull`, :meth:`begin_drain` /
+    :meth:`wait_drained` / :meth:`close`, :meth:`depth` /
+    :meth:`inflight_rows` as the ``/healthz`` load signals — so
+    ``InferenceServer``/``RouterServer`` front either batcher unchanged.
+
+    Futures resolve to ``{"tokens", "num_tokens", "finish_reason"}`` and
+    carry ``.request_id`` and ``.timing``
+    (``{queue_wait_ms, prefill_ms, decode_ms, total_ms, tokens}``) exactly
+    like the predict path's futures.
+    """
+
+    def __init__(self, engine, *, max_queue: int = 256,
+                 prefill_split: bool = False,
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 tracer: Optional[spans_mod.Tracer] = None):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.metrics = (metrics if metrics is not None
+                        else getattr(engine, "metrics", None)
+                        or metrics_mod.Metrics())
+        self.tracer = (tracer if tracer is not None
+                       else spans_mod.default_tracer)
+        self.prefill_split = bool(prefill_split)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_GenPending] = []
+        self._active: Dict[int, _GenPending] = {}   # slot -> request
+        self._prefilling = 0   # requests popped for prefill, no slot yet
+        self._closed = False
+        self._draining = False
+        self._workers = [threading.Thread(target=self._decode_loop,
+                                          name="continuous-batcher",
+                                          daemon=True)]
+        if self.prefill_split:
+            self._workers.append(threading.Thread(
+                target=self._prefill_loop, name="continuous-prefill",
+                daemon=True))
+        for w in self._workers:
+            w.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Optional[int] = None, seed: Optional[int] = None,
+               request_id: Optional[str] = None,
+               parent: Optional[spans_mod.Span] = None) -> "Future[Dict]":
+        """Queue one generation; the Future resolves to
+        ``{"tokens": [...], "num_tokens": n, "finish_reason": "eos"|"length"}``."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) > self.engine.max_prompt_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds max_prompt_len="
+                f"{self.engine.max_prompt_len}")
+        if len(prompt) + max_new_tokens > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {len(prompt) + max_new_tokens} "
+                f"exceeds max_seq_len={self.engine.max_seq_len}")
+        fut: "Future[Dict]" = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ContinuousBatcher is closed")
+            if self._draining:
+                self.metrics.incr("serving/drain_rejections")
+                raise Draining("ContinuousBatcher is draining; in-flight "
+                               "generations complete but new requests are "
+                               "refused")
+            if len(self._pending) >= self.max_queue:
+                self.metrics.incr("serving/queue_rejections")
+                raise QueueFull(
+                    f"generate queue at capacity ({len(self._pending)}/"
+                    f"{self.max_queue}); retry later")
+            self._pending.append(_GenPending(
+                prompt, max_new_tokens, float(temperature), int(top_k),
+                eos_id, seed, fut, time.perf_counter(), request_id, parent))
+            self.metrics.observe("serving/decode/queue_depth",
+                                 len(self._pending))
+            self._cond.notify_all()
+        return fut
+
+    def generate(self, prompt: Sequence[int], timeout: Optional[float] = None,
+                 **kw) -> Dict[str, Any]:
+        """Blocking convenience wrapper: ``submit(...).result(timeout)``."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def begin_drain(self) -> None:
+        """Stop admitting requests (submits raise :class:`Draining`); queued
+        and in-flight generations still run to completion. Idempotent."""
+        with self._cond:
+            if self._closed or self._draining:
+                return
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout: Optional[float] = 10.0) -> bool:
+        """Block until nothing is queued, prefilling, or decoding."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._active or self._prefilling:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the workers. With ``drain`` (default) queued + in-flight
+        generations finish first; otherwise they fail with RuntimeError."""
+        if drain:
+            self.begin_drain()
+            self.wait_drained(timeout)
+        failed = []
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                failed = [p.future for p in self._pending]
+                failed += [p.future for p in self._active.values()]
+                self._pending.clear()
+            self._cond.notify_all()
+        for f in failed:
+            if not f.cancelled():
+                f.set_exception(RuntimeError("ContinuousBatcher closed"))
+        for w in self._workers:
+            w.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def depth(self) -> int:
+        """Requests queued, not yet admitted into a slot."""
+        with self._lock:
+            return len(self._pending)
+
+    def inflight_rows(self) -> int:
+        """Sequences currently generating (slots held + prefills in
+        flight) — the replica load signal ``/healthz`` exposes."""
+        with self._lock:
+            return len(self._active) + self._prefilling
+
+    # -- worker side ---------------------------------------------------------
+
+    def _try_admit_locked(self) -> Optional[_GenPending]:
+        """Pop the oldest admissible request, or None. Caller holds the
+        lock. FIFO head-of-line only: skipping ahead would starve big
+        requests behind a stream of small ones."""
+        if not self._pending:
+            return None
+        req = self._pending[0]
+        if not self.engine.can_admit(len(req.prompt), req.max_new_tokens):
+            return None
+        self._pending.pop(0)
+        self._prefilling += 1
+        return req
+
+    def _prefill_one(self, req: _GenPending) -> None:
+        """Run the engine prefill for one popped request and activate its
+        slot (any-thread half; state updates re-acquire the lock)."""
+        try:
+            with self.tracer.span("serving/decode_admit",
+                                  args=({"request_id": req.request_id}
+                                        if req.request_id else None)):
+                info = self.engine.prefill(
+                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    seed=req.seed)
+        except Exception as exc:  # noqa: BLE001 - fan to the caller
+            with self._cond:
+                self._prefilling -= 1
+                self._cond.notify_all()
+            if not req.future.cancelled():
+                req.future.set_exception(exc)
+            return
+        req.prefill_done_at = time.perf_counter()
+        req.slot = info["slot"]
+        req.tokens.append(info["token"])
+        self.metrics.incr("serving/decode/admitted")
+        with self._cond:
+            self._prefilling -= 1
+            self._active[req.slot] = req
+            self._cond.notify_all()
+
+    def _finish(self, req: _GenPending, reason: str) -> None:
+        self.engine.release(req.slot)
+        now = time.perf_counter()
+        queue_wait_ms = 0.0
+        prefill_ms = 0.0
+        if req.prefill_done_at is not None:
+            prefill_ms = (req.prefill_done_at - req.enqueued_at) * 1000.0
+        decode_ms = (now - (req.prefill_done_at or req.enqueued_at)) * 1000.0
+        total_ms = (now - req.enqueued_at) * 1000.0
+        ntok = len(req.tokens)
+        self.metrics.observe("serving/decode/request_latency_ms", total_ms)
+        self.metrics.observe("serving/decode/tokens_per_request", ntok)
+        self.metrics.incr("serving/decode/completed")
+        self.tracer.record("serving/decode_generate", req.enqueued_at, now,
+                           parent=req.parent,
+                           args=({"request_id": req.request_id,
+                                  "tokens": ntok}
+                                 if req.request_id else {"tokens": ntok}))
+        if not req.future.cancelled():
+            req.future.request_id = req.request_id
+            req.future.timing = {
+                "queue_wait_ms": queue_wait_ms,
+                "prefill_ms": prefill_ms,
+                "decode_ms": decode_ms,
+                "total_ms": total_ms,
+                "tokens": ntok,
+            }
+            req.future.set_result({"tokens": list(req.tokens),
+                                   "num_tokens": ntok,
+                                   "finish_reason": reason})
+
+    def _step_active(self) -> None:
+        """One decode iteration + retirement. The engine call runs outside
+        the batcher lock (it has its own); retirement updates re-acquire."""
+        produced = self.engine.step()
+        finished = []
+        with self._cond:
+            for slot, tok in produced.items():
+                req = self._active.get(slot)
+                if req is None:
+                    continue
+                req.tokens.append(tok)
+                if (req.eos_id is not None and tok == req.eos_id):
+                    finished.append((req, "eos"))
+                    del self._active[slot]
+                elif len(req.tokens) >= req.max_new_tokens:
+                    finished.append((req, "length"))
+                    del self._active[slot]
+            if finished:
+                self._cond.notify_all()  # wait_drained watches _active
+        for req, reason in finished:
+            self._finish(req, reason)
+
+    def _decode_loop(self) -> None:
+        with self.tracer.activate():
+            while True:
+                admitted = False
+                if not self.prefill_split:
+                    # inline admission: fill every free slot before stepping
+                    while True:
+                        with self._cond:
+                            if self._closed:
+                                return
+                            req = self._try_admit_locked()
+                        if req is None:
+                            break
+                        self._prefill_one(req)
+                        admitted = True
+                with self._cond:
+                    if self._closed:
+                        return
+                    if not self._active and not admitted:
+                        # idle (or head-of-line request doesn't fit yet):
+                        # sleep until a submit / prefill / retire notifies.
+                        # Bounded wait while work is queued or prefilling so
+                        # admission capacity is re-checked promptly.
+                        self._cond.wait(0.05 if (self._pending
+                                                 or self._prefilling)
+                                        else None)
+                        continue
+                    have_active = bool(self._active)
+                if have_active:
+                    self._step_active()
+
+    def _prefill_loop(self) -> None:
+        while True:
+            with self._cond:
+                req = self._try_admit_locked()
+                while req is None and not self._closed:
+                    self._cond.wait(0.05 if self._pending else None)
+                    if self._closed:
+                        break
+                    req = self._try_admit_locked()
+                if self._closed:
+                    return
+            self._prefill_one(req)

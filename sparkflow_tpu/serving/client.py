@@ -257,6 +257,58 @@ class ServingClient:
                         e) from e
                 policy.sleep(delay)
 
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, seed: Optional[int] = None,
+                 request_id: Optional[str] = None,
+                 retries: Optional[int] = None,
+                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """``POST /v1/generate``: autoregressive decode of ``prompt`` (a list
+        of token ids). Returns the full reply — ``tokens``, ``num_tokens``,
+        ``finish_reason``, ``request_id``, ``timing_ms``, plus the echoed
+        ``X-Request-Id`` header as ``x_request_id_header``. Retry semantics
+        match :meth:`predict` (503s and connection errors back off and
+        re-send; 400s/500s raise immediately)."""
+        payload: Dict[str, Any] = {
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+        }
+        if eos_id is not None:
+            payload["eos_id"] = int(eos_id)
+        if seed is not None:
+            payload["seed"] = int(seed)
+        headers = {"X-Request-Id": request_id} if request_id else None
+        budget = (self.retries if retries is None else int(retries)) + 1
+        policy = self.retry_policy
+        start = policy.clock()
+        attempt = 0
+        while True:
+            try:
+                body, hdrs = self._request("/v1/generate", payload,
+                                           headers=headers,
+                                           with_headers=True,
+                                           timeout_s=timeout_s)
+                body["x_request_id_header"] = hdrs.get("X-Request-Id")
+                return body
+            except (ServingError, OSError,
+                    http.client.HTTPException) as e:
+                attempt += 1
+                if not self._retryable(e) or attempt >= budget:
+                    raise
+                delay = policy.backoff(attempt - 1)
+                hint = getattr(e, "retry_after", None)
+                if hint is not None:
+                    delay = max(delay, float(hint))
+                elapsed = policy.clock() - start
+                if (policy.deadline_s is not None
+                        and elapsed + delay > policy.deadline_s):
+                    raise RetryExhausted(
+                        f"generate against {self.url}", attempt, elapsed,
+                        e) from e
+                policy.sleep(delay)
+
     def predict_full(self, inputs, request_id: Optional[str] = None,
                      timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """One attempt (no retries), full reply: ``predictions``, ``rows``,

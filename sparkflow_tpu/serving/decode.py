@@ -1,0 +1,423 @@
+"""Autoregressive decode engine: AOT prefill ladder + fixed-shape paged decode.
+
+The predict engine (:mod:`~sparkflow_tpu.serving.engine`) is single-shot:
+one forward pass per request. LLM generation is a loop — one prefill over the
+prompt, then one model step per generated token — and the loop is where both
+recompiles and batching granularity can ruin throughput. This engine removes
+both hazards the same way the predict engine removed its latency cliff:
+
+- **Prefill** reuses the bucket-ladder idea: prompts pad to the nearest
+  page-aligned bucket and run through an AOT-compiled
+  (``jit(...).lower().compile()``) forward that captures every block's K/V
+  (:meth:`~sparkflow_tpu.models.transformer.TransformerLM.prefill`) and
+  commits it straight into the paged pool **inside the same executable** —
+  the cache never round-trips through the host.
+- **Decode** is ONE fixed-shape executable over the whole slot batch
+  (``num_slots`` lanes), whatever subset of slots is live: token ids,
+  positions, page tables and sampling knobs are dense ``[num_slots]``
+  operands, inactive lanes compute garbage into the scratch page and are
+  ignored by the host. Steady-state decode therefore never retraces —
+  pinned by a :class:`~sparkflow_tpu.analysis.runtime_guards.RecompileGuard`
+  exactly like the predict ladder.
+
+Attention inside the decode step is the pallas
+:func:`~sparkflow_tpu.ops.paged_attention` kernel over the page-table-
+indirected K/V pool managed by :class:`~sparkflow_tpu.serving.kvcache.PagedKVCache`
+(hooked in through ``TransformerLM.decode_step``'s ``attend`` callback, so
+the model defines the architecture once and the engine only swaps the cache
+layout).
+
+Sampling is on-device, per slot, under an explicit PRNG key chain
+(``[num_slots, 2]`` uint32 state, split once per sampling event): greedy when
+``temperature == 0``, temperature + optional top-k otherwise (``top_k`` is
+per-slot dynamic up to the static ``max_top_k`` compiled into the step).
+
+The engine is mechanism only — slot admission at token boundaries, queueing,
+futures and drain semantics live in
+:class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.runtime_guards import RecompileGuard
+from ..obs.spans import span as obs_span
+from ..ops import paged_attention
+from ..utils import metrics as metrics_mod
+from ..utils.tracing import annotate
+from .kvcache import OutOfPages, PagedKVCache
+
+__all__ = ["DecodeEngine"]
+
+
+def _prefill_ladder(page_size: int, max_prompt: int) -> List[int]:
+    """Page-aligned bucket ladder: page, 2*page, 4*page, ... capped at
+    ``max_prompt`` (itself included, already page-aligned)."""
+    buckets, b = [], page_size
+    while b < max_prompt:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt)
+    return buckets
+
+
+class DecodeEngine:
+    """Continuous-decode mechanism over a paged KV cache.
+
+    Parameters
+    ----------
+    model : TransformerLM | str
+        A causal LM exposing ``prefill`` / ``decode_step`` (or a registry
+        spec JSON that loads to one).
+    params : pytree | list
+        Trained parameters (flat weight list accepted, as in
+        :class:`~sparkflow_tpu.serving.engine.InferenceEngine`).
+    num_slots : int
+        Decode lanes — the fixed batch dimension of the decode step.
+    page_size : int
+        KV-cache page size in tokens.
+    num_pages : int | None
+        Pool size including the scratch page. Default fully provisions
+        every slot's worst case (``num_slots * max_pages_per_slot + 1``);
+        undersize it to exercise admission backpressure.
+    max_seq_len : int | None
+        Per-sequence cap (prompt + generated), default the largest
+        page-aligned length ``<= model.max_len``.
+    max_top_k : int
+        Static top-k ceiling compiled into the sampler; per-request
+        ``top_k`` values clamp to it.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_seq_len: Optional[int] = None, max_top_k: int = 64,
+                 seed: int = 0, warmup: bool = True,
+                 metrics: Optional[metrics_mod.Metrics] = None):
+        if isinstance(model, str):
+            from ..models import model_from_json
+            model = model_from_json(model)
+        for need in ("prefill", "decode_step"):
+            if not hasattr(model, need):
+                raise TypeError(f"model has no {need}(); DecodeEngine needs "
+                                f"a causal LM (transformer_lm)")
+        self.model = model
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        cap = (self.page_size
+               * (int(model.max_len) // self.page_size))
+        if cap < self.page_size:
+            raise ValueError(
+                f"model.max_len={model.max_len} is below one page "
+                f"(page_size={page_size})")
+        self.max_seq_len = int(max_seq_len) if max_seq_len else cap
+        if self.max_seq_len > int(model.max_len):
+            raise ValueError(f"max_seq_len={self.max_seq_len} exceeds the "
+                             f"model's max_len={model.max_len}")
+        self.max_pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
+        if num_pages is None:
+            num_pages = self.num_slots * self.max_pages_per_slot + 1
+        self.kv = PagedKVCache(num_pages, self.page_size, self.num_slots,
+                               self.max_pages_per_slot, metrics=self.metrics)
+        self.max_top_k = max(1, min(int(max_top_k), int(model.vocab_size)))
+        # prompts pad to page-aligned buckets; the ladder top also caps
+        # admissible prompt length
+        self.prefill_buckets = _prefill_ladder(
+            self.page_size, self.page_size * (self.max_seq_len
+                                              // self.page_size))
+        self.max_prompt_len = self.prefill_buckets[-1]
+
+        if isinstance(params, (list, tuple)):
+            from ..graphdef import list_to_params
+            params = list_to_params(model, list(params))
+        self._params = params
+        pool_dtype = (model.compute_dtype if model.compute_dtype is not None
+                      else jnp.float32)
+        pool_shape = (model.num_layers, num_pages, self.page_size,
+                      model.num_heads, model.head_dim)
+        self._k_pool = jnp.zeros(pool_shape, pool_dtype)
+        self._v_pool = jnp.zeros(pool_shape, pool_dtype)
+        self._keys = jnp.stack([jax.random.PRNGKey(seed + i)
+                                for i in range(self.num_slots)])
+        self._last_token = np.zeros(self.num_slots, np.int32)
+        self._temp = np.zeros(self.num_slots, np.float32)
+        self._topk = np.zeros(self.num_slots, np.int32)
+
+        self._lock = threading.Lock()
+        # expected traces: one per prefill bucket + decode + prefill sampler
+        self.recompile_guard = RecompileGuard(
+            name="serving.decode",
+            warn_after=len(self.prefill_buckets) + 2)
+        self._prefill_exes: Dict[int, Any] = {}
+        self._decode_exe: Any = None
+        self._sample_exe: Any = None
+        self.aot_compiles = 0
+        self._steps = 0
+        self._tokens_out = 0
+        self._prefills = 0
+        if warmup:
+            self.warmup()
+
+    # -- jitted functions ----------------------------------------------------
+
+    def _sample_tokens(self, logits, keys, temp, topk):
+        """Shared sampler: greedy lane when ``temp == 0``, temperature +
+        per-slot top-k (clamped to the static ``max_top_k``) otherwise.
+        Returns ``(tokens [B] int32, advanced keys [B, 2])``."""
+        split = jax.vmap(jax.random.split)(keys)           # [B, 2, 2]
+        sub, nxt = split[:, 0], split[:, 1]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        vals = jax.lax.top_k(logits, self.max_top_k)[0]    # [B, K] desc
+        kidx = jnp.clip(topk - 1, 0, self.max_top_k - 1)
+        thr = jnp.take_along_axis(vals, kidx[:, None], axis=1)
+        masked = jnp.where(logits < thr, -1e30, logits)
+        lg = jnp.where((topk > 0)[:, None], masked, logits)
+        safe_t = jnp.where(temp > 0, temp, 1.0)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(sub, lg / safe_t)
+        tok = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+        return tok, nxt
+
+    def _decode_fn(self, params, k_pool, v_pool, token, pos, table, keys,
+                   temp, topk):
+        page = self.page_size
+        bidx = jnp.arange(self.num_slots)
+
+        def attend(layer, q, k_new, v_new, cache, p):
+            kp, vp = cache
+            page_ids = table[bidx, p // page]
+            off = p % page
+            kp = kp.at[layer, page_ids, off].set(k_new.astype(kp.dtype))
+            vp = vp.at[layer, page_ids, off].set(v_new.astype(vp.dtype))
+            out = paged_attention(q, kp[layer], vp[layer], table, p + 1)
+            return out.astype(q.dtype), (kp, vp)
+
+        logits, (k_pool, v_pool) = self.model.decode_step(
+            params, (k_pool, v_pool), token, pos, attend=attend)
+        tok, keys = self._sample_tokens(logits, keys, temp, topk)
+        return tok, k_pool, v_pool, keys
+
+    def _prefill_fn(self, bucket: int):
+        model, page = self.model, self.page_size
+        npages = bucket // page
+
+        def prefill(params, k_pool, v_pool, ids, length, page_ids):
+            # causal attention makes valid rows independent of the padded
+            # tail, so no kv_mask is needed; the padded tail's K/V lands in
+            # positions >= length, which decode attention masks by length
+            logits, kvs = model.prefill(params, ids, lengths=length)
+            for i, (k, v) in enumerate(kvs):
+                # [1, heads, bucket, d] -> [npages, page, heads, d]
+                kk = jnp.transpose(k[0], (1, 0, 2)).reshape(
+                    npages, page, model.num_heads, model.head_dim)
+                vv = jnp.transpose(v[0], (1, 0, 2)).reshape(
+                    npages, page, model.num_heads, model.head_dim)
+                k_pool = k_pool.at[i, page_ids].set(kk.astype(k_pool.dtype))
+                v_pool = v_pool.at[i, page_ids].set(vv.astype(v_pool.dtype))
+            return logits, k_pool, v_pool
+
+        return prefill
+
+    def _param_struct(self):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            if not hasattr(a, "aval")
+            else jax.ShapeDtypeStruct(a.shape, a.dtype), self._params)
+
+    def _pool_struct(self):
+        return jax.ShapeDtypeStruct(self._k_pool.shape, self._k_pool.dtype)
+
+    def warmup(self) -> None:
+        """AOT-compile the decode step, the prefill-sampling helper, and
+        every prefill bucket, then pin steady state: any later trace is a
+        recompile regression (GC-R401)."""
+        with self._lock:
+            self._warmup_locked()
+
+    def _warmup_locked(self) -> None:
+        guard = self.recompile_guard
+        ps = self._param_struct()
+        pool = self._pool_struct()
+        B, maxp = self.num_slots, self.max_pages_per_slot
+        i32 = jnp.int32
+        if self._decode_exe is None:
+            with annotate("serving/decode_compile_step"):
+                self._decode_exe = jax.jit(
+                    guard.wrap(self._decode_fn),
+                    donate_argnums=(1, 2)).lower(
+                        ps, pool, pool,
+                        jax.ShapeDtypeStruct((B,), i32),
+                        jax.ShapeDtypeStruct((B,), i32),
+                        jax.ShapeDtypeStruct((B, maxp), i32),
+                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                        jax.ShapeDtypeStruct((B,), jnp.float32),
+                        jax.ShapeDtypeStruct((B,), i32)).compile()
+            self.aot_compiles += 1
+        if self._sample_exe is None:
+            with annotate("serving/decode_compile_sample"):
+                self._sample_exe = jax.jit(guard.wrap(
+                    self._sample_tokens)).lower(
+                        jax.ShapeDtypeStruct((1, self.model.vocab_size),
+                                             jnp.float32),
+                        jax.ShapeDtypeStruct((1, 2), jnp.uint32),
+                        jax.ShapeDtypeStruct((1,), jnp.float32),
+                        jax.ShapeDtypeStruct((1,), i32)).compile()
+            self.aot_compiles += 1
+        for b in self.prefill_buckets:
+            if b in self._prefill_exes:
+                continue
+            with annotate(f"serving/decode_compile_prefill_b{b}"):
+                self._prefill_exes[b] = jax.jit(
+                    guard.wrap(self._prefill_fn(b)),
+                    donate_argnums=(1, 2)).lower(
+                        ps, pool, pool,
+                        jax.ShapeDtypeStruct((1, b), i32),
+                        jax.ShapeDtypeStruct((1,), i32),
+                        jax.ShapeDtypeStruct((b // self.page_size,),
+                                             i32)).compile()
+            self.aot_compiles += 1
+        guard.mark_steady()
+
+    # -- admission / prefill -------------------------------------------------
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Token-boundary admission check: a free slot exists and the pool
+        can reserve the request's worst case."""
+        if not (1 <= prompt_len <= self.max_prompt_len):
+            return False
+        total = prompt_len + max(1, int(max_new_tokens))
+        if total > self.max_seq_len:
+            return False
+        return self.kv.can_admit(total)
+
+    def prefill(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+                temperature: float = 0.0, top_k: int = 0,
+                seed: Optional[int] = None) -> Dict[str, Any]:
+        """Admit one sequence: allocate a slot + pages, run the bucketed
+        prefill (committing K/V into the pool on-device), sample the first
+        token. Returns ``{"slot", "token", "prompt_len"}``; raises
+        :class:`~sparkflow_tpu.serving.kvcache.OutOfPages` when the request
+        cannot be admitted right now (backpressure)."""
+        prompt = list(int(t) for t in prompt)
+        n = len(prompt)
+        if not 1 <= n <= self.max_prompt_len:
+            raise ValueError(f"prompt length {n} outside [1, "
+                             f"{self.max_prompt_len}]")
+        total = n + max(1, int(max_new_tokens))
+        if total > self.max_seq_len:
+            raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
+                             f"max_seq_len={self.max_seq_len}")
+        with self._lock:
+            slot = self.kv.free_slot()
+            if slot is None:
+                raise OutOfPages("no free decode slot")
+            self.kv.alloc(slot, n, total)  # raises OutOfPages when full
+            t0 = time.perf_counter()
+            bucket = next(b for b in self.prefill_buckets if n <= b)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = prompt
+            npages = bucket // self.page_size
+            page_ids = np.zeros(npages, np.int32)  # pad -> scratch page 0
+            held = self.kv.pages_for(n, self.page_size)
+            page_ids[:held] = self.kv.page_tables()[slot, :held]
+            exe = self._prefill_exes[bucket]
+            with obs_span("serving/decode_prefill",
+                          args={"bucket": bucket, "slot": int(slot)},
+                          jax_annotation=True):
+                logits, self._k_pool, self._v_pool = exe(
+                    self._params, self._k_pool, self._v_pool, ids,
+                    np.asarray([n], np.int32), page_ids)
+            if seed is not None:
+                self._keys = self._keys.at[slot].set(
+                    jax.random.PRNGKey(int(seed)))
+            tok, key = self._sample_exe(
+                np.asarray(logits), self._keys[slot][None],
+                np.asarray([temperature], np.float32),
+                np.asarray([min(int(top_k), self.max_top_k)], np.int32))
+            self._keys = self._keys.at[slot].set(key[0])
+            first = int(np.asarray(tok)[0])
+            self._last_token[slot] = first
+            self._temp[slot] = float(temperature)
+            self._topk[slot] = min(int(top_k), self.max_top_k)
+            self._prefills += 1
+            self.metrics.observe("serving/decode/prefill_ms",
+                                 (time.perf_counter() - t0) * 1000.0)
+            self.metrics.observe("serving/decode/prompt_tokens", n)
+        return {"slot": int(slot), "token": first, "prompt_len": n}
+
+    # -- decode --------------------------------------------------------------
+
+    def step(self) -> Dict[int, int]:
+        """One decode iteration over every active slot: append a token's
+        page room, run the fixed-shape step, return ``{slot: next_token}``.
+        No-op (empty dict) when nothing is active."""
+        with self._lock:
+            active = self.kv.active_slots()
+            if active.size == 0:
+                return {}
+            t0 = time.perf_counter()
+            # the incoming token occupies position == current length: make
+            # sure its page exists, then pass the PRE-append position
+            for s in active:
+                self.kv.append(int(s))
+            lengths = self.kv.lengths()
+            pos = np.maximum(lengths - 1, 0).astype(np.int32)
+            table = self.kv.page_tables()
+            with obs_span("serving/decode_step",
+                          args={"active": int(active.size)},
+                          jax_annotation=True):
+                tok, self._k_pool, self._v_pool, self._keys = \
+                    self._decode_exe(self._params, self._k_pool,
+                                     self._v_pool, self._last_token, pos,
+                                     table, self._keys, self._temp,
+                                     self._topk)
+            tok = np.asarray(tok)
+            out = {}
+            for s in active:
+                self._last_token[s] = tok[s]
+                out[int(s)] = int(tok[s])
+            self._steps += 1
+            self._tokens_out += int(active.size)
+            dt_ms = (time.perf_counter() - t0) * 1000.0
+            self.metrics.observe("serving/decode/step_ms", dt_ms)
+            self.metrics.observe("serving/decode/step_active",
+                                 int(active.size))
+            self.metrics.observe("serving/decode/token_latency_ms",
+                                 dt_ms)  # per-token: one step = one token
+        return out
+
+    def release(self, slot: int) -> None:
+        """Retire a finished sequence at a token boundary: its pages return
+        to the pool immediately, the lane is reusable next step."""
+        with self._lock:
+            self.kv.free(int(slot))
+            self._last_token[slot] = 0
+            self._temp[slot] = 0.0
+            self._topk[slot] = 0
+
+    def active_slots(self) -> np.ndarray:
+        return self.kv.active_slots()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_slots": self.num_slots,
+                "prefill_buckets": list(self.prefill_buckets),
+                "max_seq_len": self.max_seq_len,
+                "aot_compiles": self.aot_compiles,
+                "traces": self.recompile_guard.traces,
+                "steady_traces": self.recompile_guard.steady_traces,
+                "steps": self._steps,
+                "tokens_out": self._tokens_out,
+                "prefills": self._prefills,
+                "kv": self.kv.stats(),
+            }

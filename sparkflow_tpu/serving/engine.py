@@ -23,6 +23,7 @@ quantizes the full-precision tree once at load and compiles the int8 apply —
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -93,6 +94,7 @@ class InferenceEngine:
                  quant_min_size: int = 4096,
                  compute_dtype=None,
                  warmup: bool = True,
+                 compile_cache_dir: Optional[str] = None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(graph, str):
             from ..models import model_from_json
@@ -141,6 +143,17 @@ class InferenceEngine:
         self.fallback_compiles = 0
         self._requests = 0
         self._rows = 0
+        # persistent XLA compilation cache: with a directory set, warmup's
+        # bucket compiles hit cached executables from earlier processes
+        # instead of re-running XLA — the restart-latency knob. hits/misses
+        # are estimated from cache-entry deltas around our own compiles.
+        self.compile_cache_dir: Optional[str] = None
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
+        if compile_cache_dir is not None:
+            from ..utils.hw import enable_compilation_cache
+            self.compile_cache_dir = enable_compilation_cache(
+                compile_cache_dir)
         if warmup:
             self.warmup()
 
@@ -243,15 +256,34 @@ class InferenceEngine:
                              out_shardings=rows)
         return jitted.lower(params_struct, self._x_struct(bucket)).compile()
 
+    def _cache_entries(self) -> int:
+        if self.compile_cache_dir is None:
+            return 0
+        try:
+            return len([f for f in os.listdir(self.compile_cache_dir)
+                        if not f.startswith(".")])
+        except OSError:
+            return 0
+
     def warmup(self) -> None:
         """AOT-compile every bucket. Idempotent; after it returns,
         ``predict`` never compiles for any request size."""
         with self._compile_lock:
+            before = self._cache_entries()
+            compiled_now = 0
             for b in self.buckets:
                 if b not in self._compiled:
                     with annotate(f"serving/aot_compile_b{b}"):
                         self._compiled[b] = self._compile_bucket(b)
                     self.aot_compiles += 1
+                    compiled_now += 1
+            if self.compile_cache_dir is not None and compiled_now:
+                # every compile either wrote a fresh cache entry (miss) or
+                # loaded an existing one (hit); the dir delta splits them
+                added = max(0, self._cache_entries() - before)
+                misses = min(added, compiled_now)
+                self.compile_cache_misses += misses
+                self.compile_cache_hits += compiled_now - misses
             self.recompile_guard.mark_steady()
 
     def _executable(self, bucket: int):
@@ -337,6 +369,11 @@ class InferenceEngine:
                 "steady_traces": self.recompile_guard.steady_traces,
                 "requests": requests,
                 "rows": rows,
+                "compile_cache": (
+                    None if self.compile_cache_dir is None else
+                    {"dir": self.compile_cache_dir,
+                     "hits": self.compile_cache_hits,
+                     "misses": self.compile_cache_misses}),
                 "quantize": self.quantize,
                 "mesh": (dict(self.mesh.shape) if self.mesh is not None
                          else None)}

@@ -1,0 +1,252 @@
+"""Slot-based paged KV-cache manager for autoregressive decode.
+
+Decode serving needs one KV cache per in-flight sequence, but sequences are
+ragged (a 20-token chat next to a 2048-token completion) and join/leave the
+batch every token. A dense ``[slots, max_len]`` cache would reserve worst-case
+memory for every slot; instead the pool is carved into fixed-size **pages**
+(``page_size`` tokens each) and each slot owns just the pages its tokens
+occupy, listed in a per-slot **page table** — the same indirection OS virtual
+memory and vLLM's PagedAttention use. The pallas
+:func:`~sparkflow_tpu.ops.paged_attention` kernel consumes the table directly
+(scalar-prefetched BlockSpec index maps), so the scattered pages are never
+gathered into a contiguous cache on the device.
+
+This class is the **host-side bookkeeper**: free-page list, per-slot tables
+and lengths, allocation/append/free at token granularity. The actual K/V
+arrays live on-device inside :class:`~sparkflow_tpu.serving.decode.DecodeEngine`'s
+donated state pytree; the manager just hands the engine ``page_table`` /
+``lengths`` operands each step.
+
+Admission is reservation-based: :meth:`alloc` checks that the request's
+**worst case** (prompt + max_new_tokens) fits in free pages before admitting,
+then allocates lazily as tokens arrive (:meth:`append`). A request that was
+admitted can therefore never hit out-of-pages mid-generation — backpressure
+happens once, at admission, where the batcher can map it to ``QueueFull``.
+
+Unassigned page-table entries point at page 0, a **scratch page** the manager
+never hands out: inactive slots' decode writes land there harmlessly and the
+kernel's index maps always see valid pool indices.
+
+Occupancy and fragmentation export as ``serving/kv/*`` gauges:
+``pages_total`` / ``pages_used`` / ``pages_reserved`` / ``occupancy`` (used /
+usable), ``fragmentation`` (allocated-but-empty token fraction inside used
+pages — internal fragmentation; pages are fixed-size so there is no external
+kind), ``tokens`` and ``slots_active``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import metrics as metrics_mod
+
+__all__ = ["PagedKVCache", "OutOfPages"]
+
+
+class OutOfPages(Exception):
+    """Raised by :meth:`PagedKVCache.alloc` when the reservation (worst-case
+    pages for the request) does not fit in the free pool — the admission
+    signal the continuous batcher turns into backpressure."""
+
+
+class PagedKVCache:
+    """Page bookkeeping for ``num_slots`` concurrent sequences.
+
+    Parameters
+    ----------
+    num_pages : int
+        Total pool pages **including** the reserved scratch page 0; usable
+        capacity is ``num_pages - 1`` pages.
+    page_size : int
+        Tokens per page.
+    num_slots : int
+        Decode slots (the fixed batch dimension of the decode step).
+    max_pages_per_slot : int
+        Page-table width — caps any single sequence at
+        ``max_pages_per_slot * page_size`` tokens.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, num_slots: int,
+                 max_pages_per_slot: int,
+                 metrics: Optional[metrics_mod.Metrics] = None):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is scratch), "
+                             f"got {num_pages}")
+        if page_size < 1 or num_slots < 1 or max_pages_per_slot < 1:
+            raise ValueError("page_size, num_slots, max_pages_per_slot must "
+                             "be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_slots = int(num_slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        self._lock = threading.Lock()
+        # page 0 is scratch: never allocated, absorbs inactive slots' writes
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._tables = np.zeros((self.num_slots, self.max_pages_per_slot),
+                                np.int32)
+        self._lengths = np.zeros(self.num_slots, np.int32)
+        self._pages_held = np.zeros(self.num_slots, np.int32)
+        self._reserved = np.zeros(self.num_slots, np.int32)  # beyond held
+        self._active = np.zeros(self.num_slots, bool)
+        self._export_gauges_locked()
+
+    # -- capacity ------------------------------------------------------------
+
+    @staticmethod
+    def pages_for(tokens: int, page_size: int) -> int:
+        return max(0, math.ceil(tokens / page_size))
+
+    def free_slot(self) -> Optional[int]:
+        """Lowest inactive slot index, or None when all slots are busy."""
+        with self._lock:
+            idle = np.flatnonzero(~self._active)
+            return int(idle[0]) if idle.size else None
+
+    def can_admit(self, total_tokens: int) -> bool:
+        """Whether a sequence whose worst case is ``total_tokens`` (prompt +
+        max new tokens) could be admitted right now: a free slot exists and
+        the un-reserved free pool covers its reservation."""
+        need = self.pages_for(total_tokens, self.page_size)
+        if need > self.max_pages_per_slot:
+            return False
+        with self._lock:
+            if not np.any(~self._active):
+                return False
+            return need <= len(self._free) - int(self._reserved.sum())
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def alloc(self, slot: int, prompt_tokens: int, total_tokens: int) -> None:
+        """Claim ``slot`` for a sequence: allocate pages covering the prompt
+        now, reserve (but don't allocate) the rest of the worst case so
+        :meth:`append` can never fail later. Raises :class:`OutOfPages` when
+        the reservation doesn't fit."""
+        if prompt_tokens < 1:
+            raise ValueError("prompt_tokens must be >= 1")
+        total_tokens = max(int(total_tokens), int(prompt_tokens))
+        need_now = self.pages_for(prompt_tokens, self.page_size)
+        need_total = self.pages_for(total_tokens, self.page_size)
+        if need_total > self.max_pages_per_slot:
+            raise OutOfPages(
+                f"sequence of {total_tokens} tokens needs {need_total} pages "
+                f"> max_pages_per_slot={self.max_pages_per_slot}")
+        with self._lock:
+            if self._active[slot]:
+                raise ValueError(f"slot {slot} is already active")
+            avail = len(self._free) - int(self._reserved.sum())
+            if need_total > avail:
+                self.metrics.incr("serving/kv/alloc_rejections")
+                raise OutOfPages(
+                    f"need {need_total} pages, {avail} unreserved free "
+                    f"(of {len(self._free)})")
+            self._tables[slot, :] = 0
+            for i in range(need_now):
+                self._tables[slot, i] = self._free.pop()
+            self._lengths[slot] = prompt_tokens
+            self._pages_held[slot] = need_now
+            self._reserved[slot] = need_total - need_now
+            self._active[slot] = True
+            self._export_gauges_locked()
+
+    def append(self, slot: int, n: int = 1) -> None:
+        """Extend ``slot`` by ``n`` tokens, drawing new pages from its
+        reservation at page boundaries. Never raises for admitted sequences
+        within their reservation."""
+        with self._lock:
+            if not self._active[slot]:
+                raise ValueError(f"slot {slot} is not active")
+            for _ in range(n):
+                length = int(self._lengths[slot])
+                if length % self.page_size == 0:  # first token of a new page
+                    held = int(self._pages_held[slot])
+                    if held >= self.max_pages_per_slot:
+                        raise OutOfPages(
+                            f"slot {slot} exceeded max_pages_per_slot="
+                            f"{self.max_pages_per_slot}")
+                    if self._reserved[slot] <= 0:
+                        raise OutOfPages(
+                            f"slot {slot} grew past its reservation")
+                    self._tables[slot, held] = self._free.pop()
+                    self._pages_held[slot] += 1
+                    self._reserved[slot] -= 1
+                self._lengths[slot] = length + 1
+            self._export_gauges_locked()
+
+    def free(self, slot: int) -> None:
+        """Retire ``slot``: return its pages (and unused reservation) to the
+        pool. Idempotent."""
+        with self._lock:
+            if not self._active[slot]:
+                return
+            held = int(self._pages_held[slot])
+            for i in range(held):
+                self._free.append(int(self._tables[slot, i]))
+            self._tables[slot, :] = 0
+            self._lengths[slot] = 0
+            self._pages_held[slot] = 0
+            self._reserved[slot] = 0
+            self._active[slot] = False
+            self._export_gauges_locked()
+
+    # -- device operands -----------------------------------------------------
+
+    def page_tables(self) -> np.ndarray:
+        """``[num_slots, max_pages_per_slot]`` int32 — every entry a valid
+        pool index (unassigned entries point at scratch page 0)."""
+        with self._lock:
+            return self._tables.copy()
+
+    def lengths(self) -> np.ndarray:
+        """``[num_slots]`` int32 tokens per slot (0 for inactive)."""
+        with self._lock:
+            return self._lengths.copy()
+
+    def active_slots(self) -> np.ndarray:
+        with self._lock:
+            return np.flatnonzero(self._active)
+
+    def length(self, slot: int) -> int:
+        with self._lock:
+            return int(self._lengths[slot])
+
+    # -- stats ---------------------------------------------------------------
+
+    def _export_gauges_locked(self) -> None:
+        usable = self.num_pages - 1
+        used = int(self._pages_held.sum())
+        tokens = int(self._lengths.sum())
+        frag = (1.0 - tokens / (used * self.page_size)) if used else 0.0
+        self.metrics.gauge("serving/kv/pages_total", usable)
+        self.metrics.gauge("serving/kv/pages_used", used)
+        self.metrics.gauge("serving/kv/pages_reserved",
+                           int(self._reserved.sum()))
+        self.metrics.gauge("serving/kv/occupancy",
+                           used / usable if usable else 0.0)
+        self.metrics.gauge("serving/kv/fragmentation", frag)
+        self.metrics.gauge("serving/kv/tokens", tokens)
+        self.metrics.gauge("serving/kv/slots_active",
+                           int(self._active.sum()))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            usable = self.num_pages - 1
+            used = int(self._pages_held.sum())
+            tokens = int(self._lengths.sum())
+            return {
+                "page_size": self.page_size,
+                "pages_total": usable,
+                "pages_used": used,
+                "pages_free": len(self._free),
+                "pages_reserved": int(self._reserved.sum()),
+                "occupancy": used / usable if usable else 0.0,
+                "fragmentation": (1.0 - tokens / (used * self.page_size)
+                                  if used else 0.0),
+                "tokens": tokens,
+                "slots_active": int(self._active.sum()),
+                "num_slots": self.num_slots,
+            }

@@ -182,9 +182,10 @@ class RouterServer:
 
     ``RouterServer([url1, url2, ...], port=0).start()`` binds an ephemeral
     port (read ``router.port``/``router.url`` back) and speaks the same wire
-    protocol as a single replica — ``POST /v1/predict``, ``GET /healthz``,
-    ``GET /metrics[?format=prometheus]`` — so :class:`ServingClient` points
-    at a fleet unchanged.
+    protocol as a single replica — ``POST /v1/predict``,
+    ``POST /v1/generate`` (forwarded verbatim to replicas that enable
+    decode), ``GET /healthz``, ``GET /metrics[?format=prometheus]`` — so
+    :class:`ServingClient` points at a fleet unchanged.
 
     Parameters (beyond the membership knobs, which forward to
     :class:`~sparkflow_tpu.serving.membership.Membership`):
@@ -294,7 +295,8 @@ class RouterServer:
         return max(self.hedge_floor_ms, p95) / 1000.0
 
     def _call_replica(self, replica: Replica, body: bytes,
-                      headers: Dict[str, str], slot: _CallSlot
+                      headers: Dict[str, str], slot: _CallSlot,
+                      path: str = "/v1/predict"
                       ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """One wire exchange with one replica over its keep-alive pool.
         A stale pooled connection gets one fresh retry (no response had
@@ -305,7 +307,7 @@ class RouterServer:
                 replica.pool.release(conn, reuse=reused)
                 raise _Aborted()
             try:
-                conn.request("POST", "/v1/predict", body=body,
+                conn.request("POST", path, body=body,
                              headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
@@ -335,7 +337,8 @@ class RouterServer:
 
     def _run_attempt(self, replica: Replica, body: bytes,
                      headers: Dict[str, str], slot: _CallSlot,
-                     is_hedge: bool) -> Dict[str, Any]:
+                     is_hedge: bool,
+                     path: str = "/v1/predict") -> Dict[str, Any]:
         """One classified dispatch attempt. The outcome dict carries
         ``ok``/``retryable``/``status``/``obj`` plus breaker bookkeeping
         side effects (success, failure, or drain ejection)."""
@@ -346,7 +349,8 @@ class RouterServer:
                                   args={"replica": replica.url,
                                         "hedge": is_hedge}):
                 status, obj, _hdrs = self._call_replica(replica, body,
-                                                        headers, slot)
+                                                        headers, slot,
+                                                        path)
         except _Aborted:
             # lost a hedge race: the closed socket is our doing, not the
             # replica's — no breaker bookkeeping
@@ -383,13 +387,14 @@ class RouterServer:
                 "obj": obj, "replica": replica, "hedge": is_hedge}
 
     def _attempt(self, primary: Replica, body: bytes,
-                 headers: Dict[str, str]) -> Dict[str, Any]:
+                 headers: Dict[str, str],
+                 path: str = "/v1/predict") -> Dict[str, Any]:
         """One dispatch round: the primary call, optionally hedged with a
         duplicate to a second replica after the hedge delay. First success
         wins; losers are cancelled via their :class:`_CallSlot`."""
         if not self.hedge:
             return self._run_attempt(primary, body, headers, _CallSlot(),
-                                     False)
+                                     False, path)
 
         cond = threading.Condition()
         outcomes: List[Dict[str, Any]] = []
@@ -397,7 +402,8 @@ class RouterServer:
         launched = [0]
 
         def run(replica: Replica, is_hedge: bool, slot: _CallSlot) -> None:
-            out = self._run_attempt(replica, body, headers, slot, is_hedge)
+            out = self._run_attempt(replica, body, headers, slot,
+                                    is_hedge, path)
             with cond:
                 outcomes.append(out)
                 cond.notify_all()
@@ -448,13 +454,16 @@ class RouterServer:
                                     f"{self.request_timeout_s}s"),
                 "replica": primary, "hedge": False}
 
-    def _dispatch(self, body: bytes, request_id: str
+    def _dispatch(self, body: bytes, request_id: str,
+                  path: str = "/v1/predict"
                   ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
-        """Route one predict request: cache, then retry/reroute rounds."""
+        """Route one request (predict or generate): cache, then
+        retry/reroute rounds. The result cache only fronts predict —
+        generate responses depend on sampling state, not just the body."""
         rid = {"X-Request-Id": request_id}
         faults.fire("router.dispatch")
         key = None
-        if self.cache is not None:
+        if self.cache is not None and path == "/v1/predict":
             key = ResultCache.key(body)
             hit = self.cache.get(key)
             if hit is not None:
@@ -483,7 +492,7 @@ class RouterServer:
             if replica is None:
                 self.metrics.incr("router/no_healthy_replica")
             else:
-                out = self._attempt(replica, body, headers)
+                out = self._attempt(replica, body, headers, path)
                 if out["ok"]:
                     obj = out["obj"]
                     if key is not None and "predictions" in obj:
@@ -522,7 +531,8 @@ class RouterServer:
     def _retry_after(self) -> Dict[str, str]:
         return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
 
-    def _predict(self, body: bytes, request_id: str
+    def _predict(self, body: bytes, request_id: str,
+                 path: str = "/v1/predict"
                  ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         rid = {"X-Request-Id": request_id}
         self.metrics.incr("router/requests")
@@ -547,7 +557,8 @@ class RouterServer:
         try:
             with self.tracer.span("router/request",
                                   args={"request_id": request_id}):
-                status, obj, headers = self._dispatch(body, request_id)
+                status, obj, headers = self._dispatch(body, request_id,
+                                                      path)
         except Exception as exc:  # noqa: BLE001 - surface, don't hang
             self.metrics.incr("router/http_500")
             return 500, {"error": {"code": "internal",
@@ -640,7 +651,7 @@ class RouterServer:
                                                 "message": self.path}})
 
             def do_POST(self):  # noqa: N802
-                if self.path != "/v1/predict":
+                if self.path not in ("/v1/predict", "/v1/generate"):
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
                     return
@@ -657,7 +668,8 @@ class RouterServer:
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    self._reply(*router._predict(body, request_id))
+                    self._reply(*router._predict(body, request_id,
+                                                 self.path))
                 finally:
                     router.lifecycle.end_request()
 

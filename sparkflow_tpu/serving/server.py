@@ -15,6 +15,15 @@ Endpoints
     ``{input_name: rows}`` for multi-input engines). Returns
     ``{"predictions": [...], "rows": n}``. Overload returns a structured
     ``503 {"error": {"code": "queue_full", ...}}``.
+``POST /v1/generate``
+    Autoregressive decode (requires a ``generate_batcher`` — a
+    :class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher` over a
+    :class:`~sparkflow_tpu.serving.decode.DecodeEngine`). Body
+    ``{"prompt": [token ids], "max_new_tokens": 32, "temperature": 0.0,
+    "top_k": 0, "eos_id": null, "seed": null}``. Returns
+    ``{"tokens": [...], "num_tokens": n, "finish_reason": "eos"|"length"}``
+    plus ``request_id`` and ``timing_ms``. Same backpressure contract as
+    predict: structured 503 + ``Retry-After`` on queue-full or drain.
 ``GET /healthz``
     Liveness + engine stats (buckets, compile counts, request totals) and
     the lifecycle state; flips to ``503`` once the server is draining so
@@ -51,7 +60,7 @@ import numpy as np
 from ..obs import spans as spans_mod
 from ..obs.exporters import MemoryWatcher, prometheus_text
 from ..resilience.lifecycle import Lifecycle, ServerState
-from .batcher import Draining, MicroBatcher, QueueFull
+from .batcher import ContinuousBatcher, Draining, MicroBatcher, QueueFull
 
 logger = logging.getLogger("sparkflow_tpu")
 
@@ -73,6 +82,7 @@ class InferenceServer:
 
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
                  batcher: Optional[MicroBatcher] = None,
+                 generate_batcher: Optional[ContinuousBatcher] = None,
                  max_delay_ms: float = 2.0, max_queue: int = 1024,
                  request_timeout_s: float = 30.0,
                  drain_timeout_s: float = 10.0,
@@ -86,6 +96,9 @@ class InferenceServer:
         self.batcher = batcher if batcher is not None else MicroBatcher(
             engine, max_delay_ms=max_delay_ms, max_queue=max_queue,
             tracer=self.tracer)
+        # optional decode front: a ContinuousBatcher over a DecodeEngine
+        # enables POST /v1/generate (absent -> that route 404s)
+        self.generate_batcher = generate_batcher
         self.metrics = self.batcher.metrics
         self.request_timeout_s = float(request_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -152,8 +165,12 @@ class InferenceServer:
         timeout = self.drain_timeout_s if timeout is None else timeout
         self.lifecycle.transition(ServerState.DRAINING)
         self.batcher.begin_drain()
+        if self.generate_batcher is not None:
+            self.generate_batcher.begin_drain()
         idle = self.lifecycle.wait_idle(timeout)
         drained = self.batcher.wait_drained(timeout)
+        if self.generate_batcher is not None:
+            drained = self.generate_batcher.wait_drained(timeout) and drained
         if not (idle and drained):
             logger.warning(
                 "drain timed out after %.1fs with work still in flight "
@@ -171,6 +188,8 @@ class InferenceServer:
         self._httpd.server_close()
         self._thread = None
         self.batcher.close()
+        if self.generate_batcher is not None:
+            self.generate_batcher.close()
         self.lifecycle.transition(ServerState.STOPPED)
         if (self._prev_handlers
                 and threading.current_thread() is threading.main_thread()):
@@ -193,6 +212,8 @@ class InferenceServer:
         self._httpd.server_close()
         self._thread = None
         self.batcher.close(drain=False, timeout=1.0)
+        if self.generate_batcher is not None:
+            self.generate_batcher.close(drain=False, timeout=1.0)
         self.lifecycle.transition(ServerState.STOPPED)
 
     def __enter__(self):
@@ -273,6 +294,70 @@ class InferenceServer:
             resp["timing_ms"] = {k: round(v, 3) for k, v in timing.items()}
         return 200, resp, rid
 
+    def _generate(self, body: bytes, request_id: str) -> Tuple:
+        rid = {"X-Request-Id": request_id}
+        if self.generate_batcher is None:
+            self.metrics.incr("serving/http_404")
+            return 404, {"error": {
+                "code": "not_found",
+                "message": "generation is not enabled on this server "
+                           "(no generate_batcher)"}}, rid
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            prompt = payload.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError('"prompt" must be a non-empty list of '
+                                 "integer token ids")
+            max_new = int(payload.get("max_new_tokens", 32))
+            temperature = float(payload.get("temperature", 0.0))
+            top_k = int(payload.get("top_k", 0))
+            eos_id = payload.get("eos_id")
+            eos_id = int(eos_id) if eos_id is not None else None
+            seed = payload.get("seed")
+            seed = int(seed) if seed is not None else None
+        except (ValueError, TypeError) as exc:
+            self.metrics.incr("serving/http_400")
+            return 400, {"error": {"code": "bad_request",
+                                   "message": str(exc)}}, rid
+        fut = None
+        try:
+            with self.tracer.span("serving/request",
+                                  args={"request_id": request_id}) as sp:
+                fut = self.generate_batcher.submit(
+                    prompt, max_new_tokens=max_new, temperature=temperature,
+                    top_k=top_k, eos_id=eos_id, seed=seed,
+                    request_id=request_id, parent=sp)
+                out = fut.result(timeout=self.request_timeout_s)
+        except Draining as exc:
+            self.metrics.incr("serving/http_503")
+            return 503, {"error": {"code": "draining",
+                                   "message": str(exc)}}, \
+                {**self._retry_after(), **rid}
+        except QueueFull as exc:
+            self.metrics.incr("serving/http_503")
+            return 503, {"error": {"code": "queue_full",
+                                   "message": str(exc)}}, \
+                {**self._retry_after(), **rid}
+        except ValueError as exc:
+            self.metrics.incr("serving/http_400")
+            return 400, {"error": {"code": "bad_request",
+                                   "message": str(exc)}}, rid
+        except Exception as exc:  # noqa: BLE001 - surface, don't hang
+            self.metrics.incr("serving/http_500")
+            return 500, {"error": {"code": "internal",
+                                   "message": f"{type(exc).__name__}: "
+                                              f"{exc}"}}, rid
+        self.metrics.incr("serving/http_200")
+        resp: Dict[str, Any] = dict(out)
+        resp["request_id"] = request_id
+        timing = getattr(fut, "timing", None)
+        if timing is not None:
+            resp["timing_ms"] = {k: round(v, 3) for k, v in timing.items()}
+        return 200, resp, rid
+
     def _retry_after(self) -> Dict[str, str]:
         return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
 
@@ -285,6 +370,9 @@ class InferenceServer:
         # endpoint). inflight/queued_rows stay for older scrapers.
         queue_depth = self.batcher.depth()
         in_flight = self.lifecycle.inflight + self.batcher.inflight_rows()
+        if self.generate_batcher is not None:
+            queue_depth += self.generate_batcher.depth()
+            in_flight += self.generate_batcher.inflight_rows()
         body = {"status": ("ok" if state in (ServerState.SERVING,
                                              ServerState.STARTING)
                            else state.value),
@@ -294,6 +382,14 @@ class InferenceServer:
                 "queue_depth": queue_depth,
                 "in_flight": in_flight,
                 "engine": stats}
+        if self.generate_batcher is not None:
+            gb = self.generate_batcher
+            body["decode"] = {
+                "queue_depth": gb.depth(),
+                "in_flight": gb.inflight_rows(),
+                "engine": (gb.engine.stats()
+                           if hasattr(gb.engine, "stats") else {}),
+            }
         if state in (ServerState.SERVING, ServerState.STARTING):
             return 200, body, None
         # draining/stopped: flip readiness so the load balancer ejects this
@@ -360,7 +456,11 @@ class InferenceServer:
                                                 "message": self.path}})
 
             def do_POST(self):  # noqa: N802
-                if self.path != "/v1/predict":
+                if self.path == "/v1/predict":
+                    handle = server._predict
+                elif self.path == "/v1/generate":
+                    handle = server._generate
+                else:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
                     return
@@ -383,7 +483,7 @@ class InferenceServer:
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    self._reply(*server._predict(body, request_id))
+                    self._reply(*handle(body, request_id))
                 finally:
                     server.lifecycle.end_request()
 

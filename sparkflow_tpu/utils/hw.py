@@ -86,5 +86,13 @@ def enable_compilation_cache(path: str = None) -> str:
         # cache everything (default only caches compilations > 1s)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     except Exception:  # pragma: no cover - older jax without the knobs
+        return path
+    # the cache object initializes lazily at the process's FIRST compile;
+    # if that happened before this call (with no dir configured), the new
+    # dir is silently ignored until the cache is re-initialized
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private API moved
         pass
     return path
