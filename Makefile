@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke zero-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -92,6 +92,14 @@ chaos-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
 	JAX_PLATFORMS=cpu python bench.py --elastic-straggler
+
+# ZeRO stage sweep: the sharding test suite, then a stage 0->3 parity +
+# checkpoint-interchange sweep and the two zero benches (docs/sharding.md)
+zero-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_zero_sharding.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/zero_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --dp-zero2
+	JAX_PLATFORMS=cpu python bench.py --dp-zero3
 
 # graftcheck: sharding / tracing / concurrency lint over the repo's own
 # source + the jaxpr self-check over presets x optimizers (docs/analysis.md)

@@ -465,6 +465,164 @@ def decode_throughput_main():
     print(json.dumps(out))
 
 
+def _zero_bench_env(n_dev: int = 8):
+    """8 virtual CPU devices for the zero-stage benches: set BEFORE the
+    first jax import (flags are read at backend init). Deterministic and
+    hardware-independent — the memory numbers are structural (eval_shape
+    byte accounting) and the step-time ratio compares two programs on the
+    SAME backend."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}")
+
+
+def _zero_step_setup(stage: int, n_dev: int):
+    """Build the jitted unified dp step for one zero stage plus its initial
+    (params, opt_state), on an mlp big enough that step time is compute-
+    not dispatch-bound on CPU."""
+    import jax
+    import jax.numpy as jnp
+    from sparkflow_tpu.models import model_from_json
+    from sparkflow_tpu.models.presets import mlp
+    from sparkflow_tpu.optimizers import build_optimizer
+    from sparkflow_tpu.optimizers_sharded import (
+        place_zero1_state, shard_zero3_params, sharded_update,
+        zero3_param_shardings)
+    from sparkflow_tpu.parallel.dp import make_dp_train_step
+    from sparkflow_tpu.parallel.mesh import make_mesh
+    from sparkflow_tpu.sharding import ShardingConfig
+
+    d_in, n_cls = 128, 10
+    model = model_from_json(mlp(d_in, n_cls, hidden=(512, 512)))
+    opt = build_optimizer("adam", 1e-3, None)
+    mesh = make_mesh({"dp": n_dev})
+    cfg = ShardingConfig(zero_stage=stage)
+    step = make_dp_train_step(model, opt, mesh, "x:0", "y:0", sharding=cfg)
+    p0 = model.init(jax.random.PRNGKey(0))
+    if stage == 0:
+        params, state = p0, opt.init(p0)
+    else:
+        state = place_zero1_state(
+            sharded_update(opt, n_dev, "dp").init(p0), mesh, n_dev)
+        if stage >= 3:
+            params = shard_zero3_params(p0, n_dev)
+            params = jax.tree.map(
+                jax.device_put, params,
+                zero3_param_shardings(params, mesh, n_dev))
+        else:
+            params = jax.tree.map(jnp.array, p0)
+    return model, opt, mesh, step, params, state, p0
+
+
+def _time_zero_step(step, params, state, n_dev, *, warmup=3, reps=20):
+    """Median wall time of one compiled step (seconds)."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    batch = 8 * n_dev
+    x = jnp.asarray(rs.randn(batch, 128), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)])
+    mask = jnp.ones((batch,), jnp.float32)
+    rng = jax.random.PRNGKey(1)
+    times = []
+    for i in range(warmup + reps):
+        r = jax.random.fold_in(rng, i)
+        t0 = time.perf_counter()
+        params, state, loss = step(params, state, x, y, mask, r)
+        jax.block_until_ready(loss)
+        if i >= warmup:
+            times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def dp_zero2_main():
+    """ZeRO-2 vs ZeRO-1: same model, same mesh, both axes of the win.
+    Prints ONE JSON line: {"metric": "dp_zero2_vs_zero1", ...}.
+
+    - memory: grad+opt bytes live at update time (structural eval_shape
+      accounting, ``optimizers_sharded.zero_memory_report``) vs the ideal
+      1/dp floor — stage 2 must land within 1.3x of ideal (padding and the
+      gathered-params buffer are the honest overhead).
+    - time: median compiled step time, stage 2 / stage 1 — must stay
+      within 1.10x (the all-gather moves updated params instead of
+      updates; same bytes on the wire, so parity is the expectation).
+    """
+    _zero_bench_env(8)
+    from sparkflow_tpu.optimizers_sharded import zero_memory_report
+
+    n_dev = 8
+    model, opt, mesh, step1, p1, s1, p0 = _zero_step_setup(1, n_dev)
+    _, _, _, step2, p2, s2, _ = _zero_step_setup(2, n_dev)
+    t1 = _time_zero_step(step1, p1, s1, n_dev)
+    t2 = _time_zero_step(step2, p2, s2, n_dev)
+    time_ratio = t2 / t1
+
+    rep = zero_memory_report(opt, p0, n_dev, 2)
+    bytes_ratio = rep["grad_opt_at_update"] / rep["ideal_grad_opt"]
+    ok = bytes_ratio <= 1.3 and time_ratio <= 1.10
+    out = {
+        "metric": "dp_zero2_vs_zero1",
+        "value": round(time_ratio, 3),
+        "unit": "x step time vs zero1",
+        "threshold": 1.10,
+        "pass": bool(ok),
+        "grad_opt_bytes_ratio_vs_ideal": round(bytes_ratio, 3),
+        "bytes_threshold": 1.3,
+        "grad_opt_at_update_bytes": rep["grad_opt_at_update"],
+        "ideal_grad_opt_bytes": rep["ideal_grad_opt"],
+        "zero1_step_ms": round(t1 * 1e3, 2),
+        "zero2_step_ms": round(t2 * 1e3, 2),
+        "dp": n_dev,
+        "platform": "cpu-hostdevices",
+    }
+    print(json.dumps(out))
+
+
+def dp_zero3_main():
+    """ZeRO-3 at-rest memory: params + opt state per device vs replicated.
+    Prints ONE JSON line: {"metric": "dp_zero3_memory", ...}.
+
+    The value is the at-rest fraction (sharded bytes / replicated bytes);
+    ideal is 1/dp, the threshold allows 1.3x of that for flat-layout
+    padding. Step time vs zero1 is reported informationally — stage 3
+    trades one all-gather per step for the 1/dp param residency.
+    """
+    _zero_bench_env(8)
+    from sparkflow_tpu.optimizers_sharded import zero_memory_report
+
+    n_dev = 8
+    model, opt, mesh, step1, p1, s1, p0 = _zero_step_setup(1, n_dev)
+    _, _, _, step3, p3, s3, _ = _zero_step_setup(3, n_dev)
+    t1 = _time_zero_step(step1, p1, s1, n_dev)
+    t3 = _time_zero_step(step3, p3, s3, n_dev)
+
+    rep = zero_memory_report(opt, p0, n_dev, 3)
+    at_rest = rep["params_at_rest"] + rep["opt_state_at_rest"]
+    full = rep["full_params"] + rep["full_opt_state"]
+    frac = at_rest / full
+    threshold = 1.3 / n_dev
+    out = {
+        "metric": "dp_zero3_memory",
+        "value": round(frac, 4),
+        "unit": "at-rest bytes fraction vs replicated",
+        "threshold": round(threshold, 4),
+        "pass": bool(frac <= threshold),
+        "params_at_rest_bytes": rep["params_at_rest"],
+        "opt_state_at_rest_bytes": rep["opt_state_at_rest"],
+        "full_params_bytes": rep["full_params"],
+        "full_opt_state_bytes": rep["full_opt_state"],
+        "zero1_step_ms": round(t1 * 1e3, 2),
+        "zero3_step_ms": round(t3 * 1e3, 2),
+        "zero3_vs_zero1_step_time": round(t3 / t1, 3),
+        "dp": n_dev,
+        "platform": "cpu-hostdevices",
+    }
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if "--span-overhead" in sys.argv:
         span_overhead_main()
@@ -472,5 +630,9 @@ if __name__ == "__main__":
         decode_throughput_main()
     elif "--elastic-straggler" in sys.argv:
         elastic_straggler_main()
+    elif "--dp-zero2" in sys.argv:
+        dp_zero2_main()
+    elif "--dp-zero3" in sys.argv:
+        dp_zero3_main()
     else:
         main()

@@ -44,5 +44,6 @@ __all__ = [
     "core",
     "trainer",
     "optimizers",
+    "sharding",
     "__version__",
 ]

@@ -41,6 +41,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "GC-J105": ("missed-donation",
                 "an input buffer matches the outputs aval-for-aval but is "
                 "not donated — XLA must double-buffer it"),
+    "GC-J106": ("sharding-config-mismatch",
+                "the collectives observed in a train step's jaxpr "
+                "contradict its declared ShardingConfig (e.g. zero_stage>=1 "
+                "with no reduce_scatter in the gradient path)"),
     # ast_lint (GC-A2xx): source rules over jit'd/traced functions
     "GC-A201": ("host-sync-in-jit",
                 "a host-synchronizing call (.item()/float()/np.asarray/"

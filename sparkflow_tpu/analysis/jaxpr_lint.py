@@ -28,6 +28,15 @@ GC-J104  weak-type-output   a top-level output is weakly typed — a bare
 GC-J105  missed-donation    a large input whose avals all reappear in the
                             outputs is not donated; XLA must keep input
                             and output buffers live simultaneously.
+GC-J106  sharding-config-   the collectives actually present in a train
+         mismatch           step's jaxpr contradict its declared
+                            ``ShardingConfig``: a ``zero_stage>=1`` config
+                            whose step never ``reduce_scatter``s is paying
+                            full-size gradient all-reduces (the sharded
+                            update silently degraded); a ``zero_stage=0``
+                            config whose step runs scatter machinery is
+                            mislabeled and will checkpoint/restore with
+                            the wrong layout assumptions.
 """
 
 from __future__ import annotations
@@ -40,7 +49,12 @@ from jax.sharding import PartitionSpec as P
 
 from .findings import Finding
 
-__all__ = ["lint_fn", "lint_train_step", "repo_self_check"]
+__all__ = ["lint_fn", "lint_train_step", "lint_sharding_config",
+           "lint_dp_train_step", "repo_self_check"]
+
+#: collective primitives whose presence/absence encodes the zero stage
+_SCATTER_PRIMS = frozenset({"reduce_scatter"})
+_REDUCE_PRIMS = frozenset({"psum", "reduce_scatter", "all_reduce"})
 
 #: below this, replication / double-buffering is noise, not a finding
 DEFAULT_LARGE_BYTES = 1 << 20
@@ -361,6 +375,117 @@ def lint_apply(model, input_name, output_name, *, batch: int = 8,
                    name=name or f"apply[{type(model).__name__}"
                                 f"/{output_name}]",
                    large_bytes=large_bytes, ignore=ignore)
+
+
+# ---------------------------------------------------------------------------
+# GC-J106: declared ShardingConfig vs observed collectives
+# ---------------------------------------------------------------------------
+
+
+def lint_sharding_config(fn: Callable, args: Sequence, sharding, *,
+                         name: Optional[str] = None,
+                         ignore: Sequence[str] = ()) -> List[Finding]:
+    """Check a train step's OBSERVED collectives against its declared
+    :class:`~sparkflow_tpu.sharding.ShardingConfig` (GC-J106).
+
+    The zero stage is a graph property: a stage>=1 step MUST contain a
+    ``reduce_scatter`` (the gradient merge that makes the state shards
+    sufficient), and a stage-0 step must NOT — tracing the step abstractly
+    and walking every sub-jaxpr (shard_map bodies included) reads it off
+    without executing a FLOP. A mismatch means the declared config and the
+    compiled program disagree: memory budgets, checkpoint layouts and bench
+    numbers derived from the config are all wrong for what actually runs.
+    """
+    from ..sharding import as_sharding_config
+
+    if "GC-J106" in set(ignore):
+        return []
+    cfg = as_sharding_config(sharding)
+    label = name or getattr(fn, "__name__", "fn")
+    args = tuple(jax.tree.map(_struct_like, a) for a in args)
+    closed = jax.make_jaxpr(fn)(*args)
+    prims = {eqn.primitive.name for eqn in _iter_eqns(closed.jaxpr)}
+    scatters = sorted(prims & _SCATTER_PRIMS)
+    reduces = sorted(prims & _REDUCE_PRIMS)
+    findings: List[Finding] = []
+    if cfg.zero_stage >= 1 and not scatters:
+        detail = {"declared": cfg.describe(), "observed": reduces}
+        if reduces:
+            findings.append(Finding(
+                "GC-J106",
+                f"{label}: declared zero_stage={cfg.zero_stage} but the "
+                f"step's gradient merge is {reduces} with NO reduce_scatter "
+                f"— every device still receives the FULL gradient, so the "
+                f"sharded optimizer state saves nothing at update time; "
+                f"the step was built without the sharded update (check "
+                f"that the config reached the step builder)",
+                source="jaxpr_lint", detail=detail))
+        else:
+            findings.append(Finding(
+                "GC-J106",
+                f"{label}: declared zero_stage={cfg.zero_stage} but the "
+                f"step contains no cross-device reduction at all — each "
+                f"device trains an independent model copy on its shard "
+                f"(divergent replicas, not data parallelism)",
+                source="jaxpr_lint", detail=detail))
+    elif cfg.zero_stage == 0 and scatters:
+        findings.append(Finding(
+            "GC-J106",
+            f"{label}: declared zero_stage=0 (replicated update) but the "
+            f"step runs {scatters} — the update IS sharded, and anything "
+            f"trusting the declared config (checkpoint layout conversion, "
+            f"memory budgets) is wrong for this program",
+            source="jaxpr_lint",
+            detail={"declared": cfg.describe(), "observed": scatters}))
+    return findings
+
+
+def lint_dp_train_step(model, optimizer="adam", *, mesh, sharding,
+                       input_name="x:0", label_name="y:0", batch: int = 8,
+                       ignore: Sequence[str] = (),
+                       name: Optional[str] = None) -> List[Finding]:
+    """GC-J106 over the unified dp step exactly as the trainer builds it:
+    constructs :func:`~sparkflow_tpu.parallel.dp.make_dp_train_step`'s raw
+    stepper for ``sharding`` and lints its jaxpr against the same config.
+    The repo gate traces every zero stage this way; a planted mismatch
+    (declared stage N, built stage M) is the test fixture."""
+    from ..optimizers import build_optimizer
+    from ..optimizers_sharded import sharded_update, shard_zero3_params
+    from ..parallel.dp import make_dp_train_step
+    from ..sharding import as_sharding_config
+
+    cfg = as_sharding_config(sharding)
+    if isinstance(optimizer, str):
+        opt_label, optimizer = optimizer, build_optimizer(optimizer, 0.01)
+    else:
+        opt_label = type(optimizer).__name__
+    step = make_dp_train_step(model, optimizer, mesh, input_name, label_name,
+                              sharding=cfg, _raw=True)
+    multi = isinstance(input_name, (list, tuple))
+    names = list(input_name) if multi else [input_name]
+    x_structs = _model_structs(model, names, batch)
+    x = tuple(x_structs) if multi else x_structs[0]
+    if label_name is not None:
+        y = _model_structs(model, [label_name], batch)[0]
+    else:
+        y = jax.ShapeDtypeStruct((batch, 1), np.float32)
+    mask = jax.ShapeDtypeStruct((batch,), np.float32)
+    rng = jax.random.PRNGKey(0)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    n = cfg.dp_size(mesh)
+    if cfg.zero_stage >= 1:
+        opt_state = jax.eval_shape(
+            sharded_update(optimizer, n, cfg.data_axis).init, params)
+        if cfg.zero_stage >= 3:
+            params = jax.eval_shape(lambda p: shard_zero3_params(p, n),
+                                    params)
+    else:
+        opt_state = jax.eval_shape(optimizer.init, params)
+    return lint_sharding_config(
+        step, (params, opt_state, x, y, mask, rng), cfg,
+        name=name or f"dp_train_step[{getattr(model, 'name', type(model).__name__)}"
+                     f"/{opt_label}/zero{cfg.zero_stage}]",
+        ignore=ignore)
 
 
 # ---------------------------------------------------------------------------

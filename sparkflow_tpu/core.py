@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .analysis.runtime_guards import trace_probe
 from .graphdef import GraphModel
+from .sharding import ShardingConfig, as_sharding_config
 
 
 def _masked_mean(loss_vec: jax.Array, mask: jax.Array) -> jax.Array:
@@ -128,24 +129,30 @@ def _sharded_trace_guard(fn: Callable, mesh: Mesh, batch_axis: str = "dp",
 
 def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None,
-                    infer_params: bool = False) -> Callable:
+                    infer_params: bool = False,
+                    sharding: Optional[ShardingConfig] = None) -> Callable:
     """One jitted optimizer step.
 
     Signature: ``step(params, opt_state, x, y, mask, rng) ->
-    (params, opt_state, loss)``. With a mesh, the batch is sharded over 'dp' and
-    XLA all-reduces gradients over ICI. ``infer_params=True`` takes param /
-    opt-state shardings from the arrays themselves (tp/fsdp-placed params via
+    (params, opt_state, loss)``. With a mesh, the batch is sharded over the
+    config's data axis ('dp' by default) and XLA all-reduces gradients over
+    ICI. ``infer_params=True`` takes param / opt-state shardings from the
+    arrays themselves (tp/fsdp-placed params via
     :func:`~sparkflow_tpu.parallel.tp.shard_params`) instead of pinning them
-    replicated.
+    replicated. ``sharding`` is the declarative
+    :class:`~sparkflow_tpu.sharding.ShardingConfig` this wrapper consumes for
+    row placement; zero stages >= 1 live in the whole-step shard_map builder
+    (:func:`~sparkflow_tpu.parallel.dp.make_dp_train_step`), not here.
     """
     step = trace_probe(_step_body(loss_fn, optimizer), "train_step")
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
 
+    cfg = as_sharding_config(sharding).validate(mesh, require_data_axis=False)
     step = _sharded_trace_guard(step, mesh)
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, _rows_spec(mesh))
+    data = NamedSharding(mesh, _rows_spec(mesh, cfg))
     pspec = None if infer_params else repl
     return jax.jit(
         step,
@@ -155,33 +162,41 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     )
 
 
-def _rows_spec(mesh: Mesh) -> P:
-    """Batch-row PartitionSpec for ``mesh``: over 'dp' when the mesh has one,
-    replicated otherwise — a strategy mesh like ``make_mesh({'pp': 2})`` has
-    no dp axis, and pinning P('dp') there dies inside jax with an opaque
-    unknown-axis error."""
-    return P("dp") if "dp" in mesh.axis_names else P()
+def _rows_spec(mesh: Mesh, sharding: Optional[ShardingConfig] = None) -> P:
+    """Batch-row PartitionSpec for ``mesh``: the config's data axes when the
+    mesh has them, replicated otherwise — a strategy mesh like
+    ``make_mesh({'pp': 2})`` has no dp axis, and pinning P('dp') there dies
+    inside jax with an opaque unknown-axis error (the dp-less fallback lives
+    in :meth:`ShardingConfig.data_spec`)."""
+    return as_sharding_config(sharding).data_spec(mesh)
 
 
 def _jit_epoch_like(fn: Callable, mesh: Optional[Mesh],
                     infer_params: bool = False,
-                    opt_shardings=None) -> Callable:
+                    opt_shardings=None,
+                    param_shardings=None,
+                    sharding: Optional[ShardingConfig] = None) -> Callable:
     """Shared jit wrapper for epoch-shaped programs
     ``fn(params, opt_state, data, labels, mask, rng)``. ``infer_params=True``
     leaves param/opt-state shardings to be inferred from the argument arrays
     (sharded-parameter training: tp/fsdp); the default pins them replicated
     (pure dp). ``opt_shardings`` overrides just the opt-state in/out sharding
-    with a matching NamedSharding pytree — the zero1 path, where the state
-    shards over dp while params stay replicated."""
+    with a matching NamedSharding pytree — zero stages >= 1, where the state
+    shards over dp; ``param_shardings`` does the same for params — zero
+    stage 3, where the flat param tree shards row-wise too. ``sharding``
+    supplies the row placement (data/dcn axes)."""
     fn = trace_probe(fn, getattr(fn, "__name__", "epoch_fn"))
     if mesh is None:
         return jax.jit(fn, donate_argnums=(0, 1))
+    cfg = as_sharding_config(sharding)
     fn = _sharded_trace_guard(fn, mesh)
     repl = NamedSharding(mesh, P())
-    rows = NamedSharding(mesh, _rows_spec(mesh))  # dataset rows over dp; XLA
-    # re-shards each scanned batch and all-reduces gradients over ICI
-    pspec = None if infer_params else repl
-    ospec = opt_shardings if opt_shardings is not None else pspec
+    rows = NamedSharding(mesh, _rows_spec(mesh, cfg))  # dataset rows over dp;
+    # XLA re-shards each scanned batch and all-reduces gradients over ICI
+    pspec = (param_shardings if param_shardings is not None
+             else (None if infer_params else repl))
+    ospec = opt_shardings if opt_shardings is not None else (
+        None if infer_params else repl)
     return jax.jit(
         fn,
         in_shardings=(pspec, ospec, rows, rows, rows, repl),
@@ -197,7 +212,9 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
                   infer_params: bool = False,
                   _unroll_budget: Optional[int] = None,
                   step_fn: Optional[Callable] = None,
-                  opt_shardings=None) -> Callable:
+                  opt_shardings=None,
+                  param_shardings=None,
+                  sharding: Optional[ShardingConfig] = None) -> Callable:
     """A full epoch as one compiled program.
 
     ``mode``:
@@ -283,7 +300,8 @@ def make_epoch_fn(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
     if _raw:
         return epoch
-    return _jit_epoch_like(epoch, mesh, infer_params, opt_shardings)
+    return _jit_epoch_like(epoch, mesh, infer_params, opt_shardings,
+                           param_shardings, sharding)
 
 
 # XLA:CPU runs large ops (convolutions especially) inside while loops ~30x
@@ -309,7 +327,9 @@ def make_multi_epoch_fn(loss_fn: Callable,
                         n_real: Optional[int] = None,
                         infer_params: bool = False,
                         step_fn: Optional[Callable] = None,
-                        opt_shardings=None) -> Callable:
+                        opt_shardings=None,
+                        param_shardings=None,
+                        sharding: Optional[ShardingConfig] = None) -> Callable:
     """``n_epochs`` whole epochs as ONE compiled program (``lax.scan`` over
     the epoch body): a full ``fit`` becomes a single device dispatch.
 
@@ -344,7 +364,8 @@ def make_multi_epoch_fn(loss_fn: Callable,
             unroll=_cpu_unroll(n_epochs * num_batches))
         return params, opt_state, losses
 
-    return _jit_epoch_like(run, mesh, infer_params, opt_shardings)
+    return _jit_epoch_like(run, mesh, infer_params, opt_shardings,
+                           param_shardings, sharding)
 
 
 def pad_to_batches(x: np.ndarray, batch_size: int,
@@ -366,11 +387,13 @@ def make_predict_fn(model: GraphModel, input_name, output_name: str,
                     dropout_name: Optional[str] = None,
                     dropout_value: float = 1.0,
                     mesh: Optional[Mesh] = None,
-                    infer_params: bool = False) -> Callable:
+                    infer_params: bool = False,
+                    sharding: Optional[ShardingConfig] = None) -> Callable:
     """Jitted fixed-shape inference: ``predict(params, x) -> out``.
     ``input_name`` may be a sequence of names; ``x`` is then a tuple.
-    With ``mesh``, the batch shards over 'dp'; arbitrary batch sizes are
-    padded to the axis size internally and trimmed on return.
+    With ``mesh``, the batch shards over the config's data axis ('dp' by
+    default); arbitrary batch sizes are padded to the axis size internally
+    and trimmed on return.
     ``infer_params=True`` takes param shardings from the arrays themselves
     (tp/fsdp-placed params serve IN PLACE) instead of pinning them
     replicated — mirroring :func:`make_train_step`; without it a placed
@@ -388,12 +411,15 @@ def make_predict_fn(model: GraphModel, input_name, output_name: str,
 
     if mesh is None or mesh.size <= 1:
         return jax.jit(predict)
+    cfg = as_sharding_config(sharding)
     predict = _sharded_trace_guard(predict, mesh)
     repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, _rows_spec(mesh))
+    data = NamedSharding(mesh, _rows_spec(mesh, cfg))
     pspec = None if infer_params else repl
     inner = jax.jit(predict, in_shardings=(pspec, data), out_shardings=data)
-    dp = mesh.shape.get("dp", 1)
+    dp = 1
+    for a in cfg.batch_axes(mesh):
+        dp *= int(mesh.shape[a])
 
     def padded_predict(params, x):
         # shard divisibility is handled HERE, not by callers: any batch size

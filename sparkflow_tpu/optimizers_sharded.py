@@ -1,4 +1,5 @@
-"""ZeRO-1 weight-update sharding: optimizer state + update split over ``dp``.
+"""ZeRO weight-update sharding: optimizer state, gradients and parameters
+split over ``dp``.
 
 On a pure data-parallel mesh the standard step all-reduces full gradients and
 then runs the optimizer update redundantly on every replica with the state
@@ -20,6 +21,20 @@ view of a sharded leaf is ``[1, s]`` — exactly what :func:`sharded_update`'s
 update consumes. Zero padding is inert: every registry optimizer is
 elementwise, so pad lanes never contaminate real ones and are trimmed by the
 final all-gather.
+
+Stages beyond 1 (driven by :class:`~sparkflow_tpu.sharding.ShardingConfig`):
+
+- ZeRO-2 (:func:`sharded_apply_update`): same reduce-scatter transport, but
+  the updated PARAM shards are what all-gathers back — ``apply_updates`` runs
+  on the ``[1, s]`` shards, so the full-size update tree and full-size apply
+  temporaries never exist. Same elementwise math as stage 1 (the adds happen
+  pre-gather instead of post-gather).
+- ZeRO-3 (:func:`shard_zero3_params` / :func:`gather_zero3_params`): the
+  params themselves live at rest in the flat ``[n_shards, s]`` layout and are
+  all-gathered just-in-time inside the loss. Because ``all_gather``'s
+  transpose rule IS ``psum_scatter``, differentiating through the gather
+  delivers exactly the reduce-scattered gradient shard — the ZeRO-2 scatter
+  fused into the backward, with no full gradient tree at rest.
 
 Checkpoint interop. :func:`gather_zero1_state` / :func:`shard_zero1_state`
 convert between the zero1 layout and the standard (param-shaped, replicated)
@@ -125,6 +140,103 @@ def sharded_update(inner: optax.GradientTransformation, n_shards: int,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def sharded_apply_update(inner: optax.GradientTransformation, n_shards: int,
+                         axis_name: str = "dp",
+                         dcn_axis: Optional[str] = None
+                         ) -> optax.GradientTransformation:
+    """ZeRO-2 companion of :func:`sharded_update`: identical state layout
+    and gradient transport, but the param APPLY also runs on the shards and
+    the updated param shards all-gather back.
+
+    Contract change: ``update(grads, state, params, scale=...)`` returns
+    ``(new_params, state)`` — the apply is fused, there is no full-size
+    update tree for the caller to apply. The per-element math matches
+    stage 1 exactly (``p + u`` happens per shard before the gather instead
+    of per element after it); bitwise agreement is up to XLA's collective
+    scheduling, which isn't pinned across program variants.
+    """
+    base = sharded_update(inner, n_shards, axis_name, dcn_axis)
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+
+    def update_fn(grads, state, params=None, *, scale=None):
+        if params is None:
+            raise ValueError(
+                "sharded_apply_update requires params at update time")
+        idx = jax.lax.axis_index(axis_name)
+
+        def g_shard(g):
+            flat = _flat_pad(g, n_shards)
+            sh = jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                      tiled=True)
+            if dcn_axis is not None:
+                sh = jax.lax.psum(sh, dcn_axis)
+            if scale is not None:
+                sh = sh * scale
+            return sh[None, :]
+
+        def p_shard(p):
+            flat = _flat_pad(p, n_shards)
+            s = flat.size // n_shards
+            return jax.lax.dynamic_slice(flat, (idx * s,), (s,))[None, :]
+
+        gs = jax.tree.map(g_shard, grads)
+        ps = jax.tree.map(p_shard, params)
+        us, state = inner.update(gs, state, ps)
+        new_ps = optax.apply_updates(ps, us)
+
+        def unshard(p2, like):
+            full = jax.lax.all_gather(p2[0], axis_name, axis=0, tiled=True)
+            return full[:like.size].reshape(like.shape).astype(like.dtype)
+
+        return jax.tree.map(unshard, new_ps, params), state
+
+    return optax.GradientTransformation(base.init, update_fn)
+
+
+def shard_zero3_params(params, n_shards: int):
+    """Params -> the ZeRO-3 at-rest layout: every leaf flat-padded to
+    ``[n_shards, ceil(size/n_shards)]`` (the same flattened view the zero
+    state is initialized over, so ``sharded_update(...).init`` applied to
+    the SHARDED params builds the exact stage-1/2 state layout). Place the
+    result with :func:`place_zero1_state`-style ``P(axis)`` rows so each
+    device physically holds 1/n."""
+    return _flat2d(params, n_shards)
+
+
+def gather_zero3_params(flat_params, template):
+    """ZeRO-3 flat layout -> standard param pytree shaped like ``template``
+    (real arrays or ShapeDtypeStructs). Runs OUTSIDE shard_map on global
+    arrays — the checkpoint / ``trainer.params`` direction."""
+    return jax.tree.map(
+        lambda f, t: jnp.ravel(jnp.asarray(f))[:t.size].reshape(
+            t.shape).astype(t.dtype),
+        flat_params, template)
+
+
+def zero3_param_specs(flat_params, n_shards: int, axis_name: str = "dp"):
+    """PartitionSpec pytree for ZeRO-3 at-rest params (row-sharded like the
+    state; same rule as :func:`zero1_state_specs`)."""
+    return zero1_state_specs(flat_params, n_shards, axis_name)
+
+
+def zero3_param_shardings(flat_params, mesh: Mesh, n_shards: int,
+                          axis_name: str = "dp"):
+    """NamedSharding pytree for ZeRO-3 at-rest params — what the trainer
+    pins the epoch program's param in/out shardings to."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        zero3_param_specs(flat_params, n_shards, axis_name))
+
+
+def gathered_param_view(p_local, like, axis_name: str = "dp"):
+    """Inside shard_map: reconstruct the full param from this device's
+    ``[1, s]`` shard. Linear in the shard, and ``all_gather``'s transpose is
+    ``psum_scatter`` — so a loss that consumes this view yields gradients
+    that arrive already reduce-scattered (the ZeRO-3 backward fusion)."""
+    full = jax.lax.all_gather(p_local[0], axis_name, axis=0, tiled=True)
+    return full[:like.size].reshape(like.shape).astype(like.dtype)
+
+
 def zero1_state_specs(state, n_shards: int, axis_name: str = "dp"):
     """PartitionSpec pytree for a zero1 state: ``[n_shards, ...]`` leaves
     shard row-wise over ``axis_name``, everything else replicates. Works on
@@ -228,3 +340,66 @@ def state_bytes_per_device(state) -> int:
             shape = getattr(leaf, "shape", ())
         total += int(np.prod(shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
     return total
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(np.prod(getattr(l, "shape", ()), dtype=np.int64))
+               * np.dtype(l.dtype).itemsize for l in jax.tree.leaves(tree))
+
+
+def _row_shard_bytes(tree, n_shards: int) -> int:
+    """Per-device bytes of a zero-layout tree: ``[n_shards, s]`` leaves
+    contribute one row, everything else (scalars, counts) contributes full."""
+    total = 0
+    for l in jax.tree.leaves(tree):
+        shape = tuple(getattr(l, "shape", ()))
+        if len(shape) >= 2 and shape[0] == n_shards:
+            shape = (1,) + shape[1:]
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(l.dtype).itemsize
+    return total
+
+
+def zero_memory_report(inner: optax.GradientTransformation, params,
+                       n_shards: int, zero_stage: int) -> dict:
+    """Structural (eval_shape-exact) per-device byte accounting for one zero
+    stage — what ``bench.py --dp-zero2`` / ``--dp-zero3`` report, valid on
+    any backend because it measures layouts, not allocator watermarks.
+
+    - ``params_at_rest`` — param bytes resident per device between steps.
+    - ``grads_at_update`` — gradient representation entering the optimizer
+      update (full tree at stage 0; the post-scatter ``[1, s]`` shards at
+      stages 1-3).
+    - ``opt_state_at_rest`` — optimizer state per device (per-param leaves
+      row-sharded at stages >= 1; scalar counts replicate).
+    - ``apply_temps`` — the transient the apply step materializes: the
+      all-gathered full update tree at stages 0-1, shard-sized at 2-3.
+    - ``ideal_grad_opt`` — the 1/n_shards share of (full grads + full opt
+      state): the denominator of the bench's 1.3x acceptance ratio
+      (padding and replicated scalars are why measured > ideal).
+    """
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(f"zero_stage must be 0..3, got {zero_stage!r}")
+    params_b = _tree_bytes(params)
+    opt_std = jax.eval_shape(inner.init, params)
+    opt_std_b = _tree_bytes(opt_std)
+    if zero_stage == 0:
+        report = dict(params_at_rest=params_b, grads_at_update=params_b,
+                      opt_state_at_rest=opt_std_b, apply_temps=params_b)
+    else:
+        flat = jax.eval_shape(lambda p: _flat2d(p, n_shards), params)
+        opt_z = jax.eval_shape(lambda p: inner.init(_flat2d(p, n_shards)),
+                               params)
+        shard_b = _row_shard_bytes(flat, n_shards)
+        report = dict(
+            params_at_rest=(shard_b if zero_stage >= 3 else params_b),
+            grads_at_update=shard_b,
+            opt_state_at_rest=_row_shard_bytes(opt_z, n_shards),
+            apply_temps=(params_b if zero_stage == 1 else shard_b))
+    report["grad_opt_at_update"] = (report["grads_at_update"]
+                                    + report["opt_state_at_rest"])
+    report["ideal_grad_opt"] = (params_b + opt_std_b) / max(n_shards, 1)
+    report["full_params"] = params_b
+    report["full_opt_state"] = opt_std_b
+    report["n_shards"] = n_shards
+    report["zero_stage"] = zero_stage
+    return report

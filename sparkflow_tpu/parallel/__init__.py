@@ -22,7 +22,9 @@ contribution instead of stalling the fleet.
 
 from .mesh import default_mesh, make_mesh, mesh_axis_size
 from . import collectives
-from .dp import make_dp_shardmap_train_step, make_dp_zero1_train_step
+from .dp import (make_dp_shardmap_train_step, make_dp_train_step,
+                 make_dp_zero1_train_step)
+from ..sharding import ShardingConfig
 from .elastic import (ElasticDPEngine, ElasticParamStore, ElasticResult,
                       InProcessTransport, PushResult, ReplicaSpec, SparseRows,
                       decode_grads, encode_grads,
@@ -31,6 +33,7 @@ from .ep import make_moe_shardmap_train_step, place_moe_params
 from .hyper import HyperResult, hyperparameter_search
 
 __all__ = ["default_mesh", "make_mesh", "mesh_axis_size", "collectives",
+           "ShardingConfig", "make_dp_train_step",
            "make_dp_shardmap_train_step", "make_dp_zero1_train_step",
            "make_moe_shardmap_train_step",
            "place_moe_params", "HyperResult", "hyperparameter_search",

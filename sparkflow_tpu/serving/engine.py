@@ -34,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..analysis.runtime_guards import RecompileGuard
 from ..core import _sharded_trace_guard
 from ..obs.spans import span as obs_span
+from ..sharding import as_sharding_config
 from ..resilience import faults
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
@@ -73,6 +74,11 @@ class InferenceEngine:
         Top of the bucket ladder; larger requests run in max_batch chunks.
     mesh : jax.sharding.Mesh | None
         dp mesh to shard batches over (params replicated).
+    sharding : ShardingConfig | dict | None
+        Declarative placement (``sparkflow_tpu.sharding.ShardingConfig``);
+        serving consumes its ``data_axis``/``dcn_axis`` for batch rows —
+        the same config a Trainer fit used works here unchanged (zero
+        stages only affect training; served params stay replicated).
     quantize : None | 'weight_only' | 'dynamic'
         int8 serving via ``utils.quant``. ``quant_min_size`` forwards to
         :func:`~sparkflow_tpu.utils.quant.quantize_params` (kernels below it
@@ -90,6 +96,7 @@ class InferenceEngine:
                  dropout_value: float = 1.0,
                  max_batch: int = 64,
                  mesh=None,
+                 sharding=None,
                  quantize: Optional[str] = None,
                  quant_min_size: int = 4096,
                  compute_dtype=None,
@@ -107,6 +114,11 @@ class InferenceEngine:
         self.dropout_value = dropout_value
         self.max_batch = int(max_batch)
         self.mesh = mesh
+        self.sharding = as_sharding_config(sharding)
+        if self.mesh is not None:
+            # fail on a typo'd axis at construction, not first request; a
+            # mesh without the data axis is fine (rows replicate)
+            self.sharding.validate(self.mesh, require_data_axis=False)
         self.quantize = quantize
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
 
@@ -246,9 +258,14 @@ class InferenceEngine:
         else:
             predict = _sharded_trace_guard(predict, mesh)
             repl = NamedSharding(mesh, P())
-            dp = mesh.shape.get("dp", 1)
-            rows = (NamedSharding(mesh, P("dp"))
-                    if "dp" in mesh.axis_names and bucket % dp == 0 and dp > 1
+            # rows shard over the config's batch axes (data_axis + optional
+            # dcn_axis) when the bucket divides their product, else replicate
+            cfg = self.sharding
+            dp = 1
+            for a in cfg.batch_axes(mesh):
+                dp *= mesh.shape[a]
+            rows = (cfg.data_sharding(mesh)
+                    if dp > 1 and bucket % dp == 0
                     else repl)
             data = (jax.tree.map(lambda _: rows, self._x_struct(bucket))
                     if self._multi else rows)
@@ -363,6 +380,7 @@ class InferenceEngine:
         with self._stats_lock:
             requests, rows = self._requests, self._rows
         return {"buckets": list(self.buckets),
+                "sharding": self.sharding.describe(),
                 "aot_compiles": self.aot_compiles,
                 "fallback_compiles": self.fallback_compiles,
                 "traces": self.recompile_guard.traces,

@@ -1,0 +1,196 @@
+"""One declarative sharding config for training and serving.
+
+Before this module every placement decision lived in a different place:
+``parallel/dp.py`` had one builder per strategy (replicated shard_map,
+zero1), ``core.py``'s jit wrappers hard-coded ``P('dp')`` rows and
+replicated params, the trainer's ``weight_update_sharding`` knob toggled
+exactly one of them, and ``serving/engine.py`` pinned its own copies. A new
+placement (ZeRO-2/3, host offload) would have meant yet another builder and
+yet another knob.
+
+:class:`ShardingConfig` is the single declarative description those layers
+now consume:
+
+- ``data_axis`` / ``dcn_axis`` — where batch rows go (fast ICI axis, plus an
+  optional slow cross-slice axis for hierarchical reduction).
+- ``zero_stage`` — how much of the update pipeline shards over ``data_axis``
+  (Xu et al., arXiv:2004.13336):
+
+  ===== ==========================================================
+  stage  sharded over dp
+  ===== ==========================================================
+  0      nothing (replicated update; grads all-reduce)
+  1      optimizer state (grads reduce-scatter, updates all-gather)
+  2      + gradient/update application (params all-gather, no
+         full-size update temporaries)
+  3      + parameters at rest (all-gathered just-in-time in the
+         forward; the backward's all_gather transpose IS the
+         reduce-scatter, so gradients never materialize full-size
+         outside AD transients)
+  ===== ==========================================================
+
+- ``param_axes`` — per-parameter placement for the GSPMD path: ``'auto'``
+  derives megatron/fsdp specs from the mesh
+  (:func:`~sparkflow_tpu.parallel.tp.derive_param_pspecs`), ``None``
+  replicates, or an explicit pspec pytree. ZeRO stages and ``param_axes``
+  are the SAME decision expressed on different axes — fsdp shards each
+  tensor's largest dim at rest via the partitioner, stage 3 shards the
+  flattened concatenation at rest via shard_map; both pay a just-in-time
+  gather per step (docs/sharding.md).
+- ``offload_opt_state`` — park optimizer state in host memory between
+  steps (models whose state exceeds HBM even at 1/dp).
+
+Import discipline: this module imports only jax — ``core``, ``trainer``,
+``parallel/*``, ``serving`` and ``analysis`` all import it, never the
+reverse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ZERO_STAGES = (0, 1, 2, 3)
+
+
+@dataclass(frozen=True)
+class ShardingConfig:
+    """Declarative placement for a train/serve program. Frozen; derive
+    variants with :meth:`replace`."""
+
+    data_axis: str = "dp"
+    dcn_axis: Optional[str] = None
+    zero_stage: int = 0
+    param_axes: Any = "auto"
+    offload_opt_state: bool = False
+
+    def __post_init__(self):
+        if self.zero_stage not in ZERO_STAGES:
+            raise ValueError(
+                f"zero_stage must be one of {ZERO_STAGES}, got "
+                f"{self.zero_stage!r}")
+        if not self.data_axis or not isinstance(self.data_axis, str):
+            raise ValueError(
+                f"data_axis must be a non-empty mesh axis name, got "
+                f"{self.data_axis!r}")
+        if self.dcn_axis == self.data_axis:
+            # without this, axes=('dp','dp') fails deep inside psum /
+            # shard_map with an opaque duplicate-axis error
+            raise ValueError(
+                f"dcn_axis={self.dcn_axis!r} must name a DIFFERENT mesh axis "
+                f"than data_axis={self.data_axis!r}: the two-level reduction "
+                f"needs a distinct slow (cross-slice) axis next to the fast "
+                f"ICI one")
+
+    # -- validation ---------------------------------------------------------
+
+    def validate(self, mesh: Mesh, require_data_axis: Optional[bool] = None
+                 ) -> "ShardingConfig":
+        """Check this config against an actual mesh; raise an actionable
+        ``ValueError`` instead of letting shard_map die on an unknown axis.
+
+        ``require_data_axis`` defaults to ``zero_stage >= 1`` — a dp-less
+        mesh (e.g. ``make_mesh({'pp': 2})``) is fine for plain GSPMD
+        programs (rows fall back to replicated, see :meth:`data_spec`) but
+        cannot host a sharded update.
+        """
+        if require_data_axis is None:
+            require_data_axis = self.zero_stage >= 1
+        if require_data_axis and self.data_axis not in mesh.axis_names:
+            raise ValueError(
+                f"zero_stage={self.zero_stage} shards the update over mesh "
+                f"axis {self.data_axis!r}, but the mesh only has axes "
+                f"{list(mesh.axis_names)}. Build the mesh with a "
+                f"'{self.data_axis}' axis (e.g. make_mesh({{'"
+                f"{self.data_axis}': N}})) or set zero_stage=0.")
+        if self.dcn_axis is not None and self.dcn_axis not in mesh.axis_names:
+            # silently downgrading a typo'd axis would replicate the batch
+            # over the real dcn axis (redundant identical updates per slice)
+            raise ValueError(
+                f"dcn_axis={self.dcn_axis!r} is not a mesh axis "
+                f"{list(mesh.axis_names)}")
+        return self
+
+    # -- derived placements -------------------------------------------------
+
+    def batch_axes(self, mesh: Optional[Mesh] = None) -> tuple:
+        """The (slow, fast) batch axes this config shards rows over,
+        restricted to axes the mesh actually has when one is given."""
+        axes = ((self.dcn_axis,) if self.dcn_axis else ()) + (self.data_axis,)
+        if mesh is not None:
+            axes = tuple(a for a in axes if a in mesh.axis_names)
+        return axes
+
+    def data_spec(self, mesh: Mesh) -> P:
+        """Batch-row PartitionSpec: over the batch axes present in the mesh,
+        replicated when none are — a strategy mesh like
+        ``make_mesh({'pp': 2})`` has no dp axis, and pinning ``P('dp')``
+        there dies inside jax with an opaque unknown-axis error."""
+        axes = self.batch_axes(mesh)
+        if not axes:
+            return P()
+        return P(axes if len(axes) > 1 else axes[0])
+
+    def data_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.data_spec(mesh))
+
+    def replicated(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P())
+
+    def dp_size(self, mesh: Mesh) -> int:
+        """Number of update shards = size of ``data_axis`` (1 on a dp-less
+        mesh)."""
+        return int(mesh.shape.get(self.data_axis, 1))
+
+    def shards_opt_state(self) -> bool:
+        return self.zero_stage >= 1
+
+    def shards_params(self) -> bool:
+        return self.zero_stage >= 3
+
+    def describe(self) -> dict:
+        """Flat dict for logs / ``stats()`` / the graftcheck lint."""
+        return {
+            "data_axis": self.data_axis,
+            "dcn_axis": self.dcn_axis,
+            "zero_stage": self.zero_stage,
+            "param_axes": (self.param_axes if isinstance(
+                self.param_axes, (str, type(None))) else "explicit"),
+            "offload_opt_state": self.offload_opt_state,
+        }
+
+    def replace(self, **kw) -> "ShardingConfig":
+        return dataclasses.replace(self, **kw)
+
+    # -- construction shims -------------------------------------------------
+
+    @classmethod
+    def from_legacy(cls, weight_update_sharding: str = "auto",
+                    dp_axis: str = "dp", dcn_axis: Optional[str] = None,
+                    param_axes: Any = "auto") -> "ShardingConfig":
+        """Map the trainer's pre-config knobs onto a ShardingConfig.
+        ``'auto'``/``'on'`` request stage 1 (the trainer's eligibility gate
+        may still decline 'auto'); ``'off'`` is stage 0."""
+        if weight_update_sharding not in ("auto", "on", "off"):
+            raise ValueError(
+                f"weight_update_sharding must be 'auto', 'on' or 'off', got "
+                f"{weight_update_sharding!r}")
+        stage = 0 if weight_update_sharding == "off" else 1
+        return cls(data_axis=dp_axis, dcn_axis=dcn_axis, zero_stage=stage,
+                   param_axes=param_axes)
+
+
+def as_sharding_config(value) -> ShardingConfig:
+    """Coerce user input (None | ShardingConfig | dict) to a ShardingConfig."""
+    if value is None:
+        return ShardingConfig()
+    if isinstance(value, ShardingConfig):
+        return value
+    if isinstance(value, dict):
+        return ShardingConfig(**value)
+    raise TypeError(
+        f"sharding must be a ShardingConfig, a dict of its fields, or None; "
+        f"got {type(value).__name__}")

@@ -49,6 +49,7 @@ from .core import (make_epoch_fn, make_loss_fn, make_multi_epoch_fn,
                    make_predict_fn, pad_to_batches)
 from .graphdef import GraphDef, GraphModel, params_to_list
 from .optimizers import build_optimizer
+from .sharding import ShardingConfig, as_sharding_config
 
 logger = logging.getLogger("sparkflow_tpu")
 
@@ -138,7 +139,8 @@ class Trainer:
                  weight_update_sharding: str = "auto",
                  debug_recompiles: bool = False,
                  strategy: Optional[str] = None,
-                 elastic: Optional[Dict[str, Any]] = None):
+                 elastic: Optional[Dict[str, Any]] = None,
+                 sharding: Union[ShardingConfig, dict, None] = None):
         if isinstance(graph, GraphDef):
             self.model = GraphModel(graph, compute_dtype)
         elif isinstance(graph, str):
@@ -200,6 +202,13 @@ class Trainer:
                 f"weight_update_sharding must be 'auto', 'on', or 'off'; "
                 f"got {weight_update_sharding!r}")
         self.weight_update_sharding = weight_update_sharding
+        # the declarative ShardingConfig (sharding.py) supersedes the legacy
+        # knob when given: its zero_stage (0-3) is an explicit request —
+        # ineligible fits raise instead of silently falling back — and its
+        # data/dcn axes + offload flag drive the unified dp step builder.
+        # None keeps the weight_update_sharding semantics above.
+        self.sharding = (as_sharding_config(sharding)
+                         if sharding is not None else None)
         # training strategy: None/'sync' is the synchronous mesh path below;
         # 'elastic_dp' routes fit() through parallel.elastic — bounded-
         # staleness async replicas over a versioned parameter store (the
@@ -230,6 +239,9 @@ class Trainer:
         self.recompile_report: Optional[str] = None
         self.recompile_findings: list = []
         self._zero1_active = False
+        self._zero_stage = 0        # resolved per fit: 0..3
+        self._zero3_template = None  # standard param shapes for stage-3 fits
+        self._offload_active = False
         # divergence detection: a non-finite epoch loss always WARNS
         # (post-hoc on the fused path); halt_on_nan=True additionally stops
         # the fit at that epoch, returning the state from before the NaN
@@ -286,6 +298,16 @@ class Trainer:
             # the stage layout; sp: replicated params), not from megatron/
             # ZeRO rules; _strategy_task refuses an explicit user pytree
             return None
+        if self.sharding is not None and self.sharding.param_axes != "auto":
+            # the declarative config's per-param placement supersedes the
+            # legacy param_sharding knob: None -> replicated, a pytree ->
+            # explicit PartitionSpecs ('auto' defers to the knob below)
+            pa = self.sharding.param_axes
+            if pa is not None and isinstance(pa, str):
+                raise ValueError(
+                    f"ShardingConfig.param_axes must be 'auto', None, or a "
+                    f"PartitionSpec pytree; got {pa!r}")
+            return pa
         if self.param_sharding is None:
             return None
         if not isinstance(self.param_sharding, str):
@@ -449,32 +471,77 @@ class Trainer:
 
         return step_fn
 
+    def _data_axis(self) -> str:
+        return (self.sharding.data_axis if self.sharding is not None
+                else "dp")
+
     def _dp_size(self) -> int:
         from .parallel.mesh import mesh_axis_size
-        return mesh_axis_size(self.mesh, "dp")
+        return mesh_axis_size(self.mesh, self._data_axis())
 
-    # -- ZeRO-1 weight-update sharding (optimizers_sharded) -----------------
+    # -- ZeRO weight-update/param sharding (optimizers_sharded) -------------
 
-    def _resolve_zero1(self, strategy: str, pspecs, params) -> bool:
-        """Decide whether this fit shards the weight update over dp.
+    def _active_cfg(self) -> ShardingConfig:
+        """The ShardingConfig in effect for the current fit: the explicit
+        one when given, else the legacy knobs mapped onto a config — with
+        ``zero_stage`` pinned to what :meth:`_resolve_zero_stage` decided."""
+        base = (self.sharding if self.sharding is not None
+                else ShardingConfig())
+        return base.replace(zero_stage=self._zero_stage)
+
+    def _resolve_zero_stage(self, strategy: str, pspecs, params) -> int:
+        """Decide how much of the weight update shards over dp (zero stage
+        0-3).
 
         Eligible: default (pure-dp) strategy, replicated params (on tp/fsdp
-        meshes the opt state already shards WITH the params — zero1 would be
-        a no-op at best), and dp >= 2. 'auto' additionally requires the
-        optimizer to carry per-param state (there is nothing to shard for
-        sgd) and declines when clip_norm / ema_decay are configured: the
-        global-norm clip would measure only its shard's norm, and EMA
-        extraction expects the standard layout.
+        meshes the opt state already shards WITH the params — a zero stage
+        would be a no-op at best), and dp >= 2. The legacy
+        ``weight_update_sharding`` knob maps 'off'->0 and 'on'/'auto'->1:
+        'auto' additionally requires the optimizer to carry per-param state
+        (there is nothing to shard for sgd) and declines when clip_norm /
+        ema_decay are configured — the global-norm clip would measure only
+        its shard's norm, and EMA extraction expects the standard layout.
+        An explicit ``sharding=ShardingConfig(zero_stage=N)`` is a REQUEST:
+        ineligible fits raise an actionable ValueError instead of silently
+        falling back.
         """
-        mode = self.weight_update_sharding
-        if mode == "off":
-            return False
+        cfg_opts = self._opt_cfg or {}
+        blocked = [k for k in ("clip_norm", "ema_decay") if cfg_opts.get(k)]
         eligible = (strategy == "default" and pspecs is None
                     and self.mesh is not None
-                    and "dp" in self.mesh.axis_names
+                    and self._data_axis() in self.mesh.axis_names
                     and self._dp_size() >= 2)
-        cfg = self._opt_cfg or {}
-        blocked = [k for k in ("clip_norm", "ema_decay") if cfg.get(k)]
+        if self.sharding is not None:
+            stage = self.sharding.zero_stage
+            if stage == 0:
+                return 0
+            if self.mesh is None:
+                raise ValueError(
+                    f"sharding.zero_stage={stage} shards the update over "
+                    f"mesh axis {self.sharding.data_axis!r} but the trainer "
+                    f"has no mesh; pass mesh=make_mesh({{'"
+                    f"{self.sharding.data_axis}': N}}) or use zero_stage=0")
+            # dp-less / undersized mesh: the config's own validation message
+            self.sharding.validate(self.mesh, require_data_axis=True)
+            if not eligible:
+                raise ValueError(
+                    f"sharding.zero_stage={stage} needs a pure-dp fit with "
+                    f"replicated params and {self.sharding.data_axis} >= 2 "
+                    f"(got strategy={strategy!r}, sharded-params="
+                    f"{pspecs is not None}, {self.sharding.data_axis}="
+                    f"{self._dp_size()}); use zero_stage=0 or a "
+                    f"{self.sharding.data_axis}-axis mesh")
+            if blocked:
+                raise ValueError(
+                    f"sharding.zero_stage={stage} is incompatible with "
+                    f"optimizer options {blocked}: the shard-local update "
+                    f"would break their global-layout math (clip_norm "
+                    f"measures a global norm; ema extraction expects the "
+                    f"standard layout)")
+            return stage
+        mode = self.weight_update_sharding
+        if mode == "off":
+            return 0
         if mode == "on":
             if not eligible:
                 logger.warning(
@@ -482,30 +549,32 @@ class Trainer:
                     "mesh with dp >= 2 (got strategy=%r, sharded-params=%s, "
                     "dp=%d); training with the replicated update", strategy,
                     pspecs is not None, self._dp_size())
-                return False
+                return 0
             if blocked:
                 logger.warning(
                     "weight_update_sharding='on' is incompatible with %s "
                     "(shard-local update would break their global-layout "
                     "math); training with the replicated update", blocked)
-                return False
-            return True
+                return 0
+            return 1
         # auto
         if not eligible or blocked:
-            return False
+            return 0
         from .optimizers_sharded import has_per_param_state
-        return has_per_param_state(self.optimizer, params)
+        return 1 if has_per_param_state(self.optimizer, params) else 0
 
-    def _make_zero1_step(self):
-        """The per-batch step_fn for the epoch machinery: the raw zero1
-        stepper runs its own shard_map, so — exactly like the pp/sp strategy
-        steps — it must run under unsharded_attention (re-wrapping the
-        attention kernel over the same mesh axes is invalid)."""
+    def _make_zero_step(self, param_template=None):
+        """The per-batch step_fn for the epoch machinery: the raw unified dp
+        stepper (stage 1-3) runs its own shard_map, so — exactly like the
+        pp/sp strategy steps — it must run under unsharded_attention
+        (re-wrapping the attention kernel over the same mesh axes is
+        invalid)."""
         from .ops.attention import unsharded_attention
-        from .parallel.dp import make_dp_zero1_train_step
-        raw = make_dp_zero1_train_step(self.model, self.optimizer, self.mesh,
-                                       self.input_name, self.label_name,
-                                       _raw=True)
+        from .parallel.dp import make_dp_train_step
+        raw = make_dp_train_step(self.model, self.optimizer, self.mesh,
+                                 self.input_name, self.label_name,
+                                 sharding=self._active_cfg(),
+                                 param_template=param_template, _raw=True)
 
         def step_fn(p, o, x, y, m, r):
             with unsharded_attention():
@@ -513,27 +582,81 @@ class Trainer:
 
         return step_fn
 
+    def _wrap_offload(self, epoch_fn, opt_shardings):
+        """``sharding.offload_opt_state=True``: the optimizer state lives on
+        HOST between epoch calls — device_put with its shardings just before
+        the program runs (so donation still sees correctly-placed buffers)
+        and device_get right after. Trades a PCIe round-trip per epoch for
+        the state's bytes of device memory; only the loop path supports it
+        (the fused multi-epoch program never returns to the host)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = (NamedSharding(self.mesh, P())
+                if self.mesh is not None else None)
+
+        def wrapped(params, opt_state, *rest):
+            place = opt_shardings if opt_shardings is not None else (
+                jax.tree.map(lambda _: repl, opt_state) if repl is not None
+                else None)
+            if place is not None:
+                opt_state = jax.tree.map(jax.device_put, opt_state, place)
+            params, opt_state, losses = epoch_fn(params, opt_state, *rest)
+            return params, jax.device_get(opt_state), losses
+
+        return wrapped
+
+    def _params_to_ckpt(self, params):
+        """Checkpoints (and ``self.params`` / TrainResult) always hold the
+        STANDARD param layout; stage-3 fits convert from the flat sharded
+        tree. Idempotent: params already in standard shape pass through, so
+        post-fit callers (ema_weights) can't double-convert."""
+        if self._zero_stage < 3 or self._zero3_template is None:
+            return params
+        t_leaves = jax.tree.leaves(self._zero3_template)
+        p_leaves = jax.tree.leaves(params)
+        if all(tuple(p.shape) == tuple(t.shape)
+               for p, t in zip(p_leaves, t_leaves)):
+            return params
+        from .optimizers_sharded import gather_zero3_params
+        return gather_zero3_params(params, self._zero3_template)
+
+    def _params_from_ckpt(self, params):
+        """Restore-side inverse of :meth:`_params_to_ckpt`: re-flatten and
+        re-shard standard params for THIS mesh's dp size and place them."""
+        if self._zero_stage < 3:
+            return params
+        from .optimizers_sharded import (shard_zero3_params,
+                                         zero3_param_shardings)
+        dp_n = self._dp_size()
+        flat = shard_zero3_params(params, dp_n)
+        shards = zero3_param_shardings(flat, self.mesh, dp_n,
+                                       self._data_axis())
+        return jax.tree.map(jax.device_put, flat, shards)
+
     def _opt_to_ckpt(self, params, opt_state):
         """Checkpoints always hold the STANDARD (param-shaped) opt state, so
-        directories stay interchangeable between zero1-on/off runs and
-        across mesh-shape changes."""
+        directories stay interchangeable across zero stages 0-3 and mesh-
+        shape changes. ``params`` may arrive in either layout (stage-3 call
+        sites hold the flat tree)."""
         if not self._zero1_active:
             return opt_state
         from .optimizers_sharded import gather_zero1_state
-        return gather_zero1_state(self.optimizer, params, opt_state,
+        return gather_zero1_state(self.optimizer,
+                                  self._params_to_ckpt(params), opt_state,
                                   self._dp_size())
 
     def _opt_from_ckpt(self, params, opt_state):
         """Restore-side inverse of :meth:`_opt_to_ckpt`: re-pad and re-shard
         the standard state for THIS mesh's dp size (which may differ from
-        the writing run's) and place the shards."""
+        the writing run's) and place the shards. ``params`` must be the
+        STANDARD layout (restore converts the opt state before the stage-3
+        param flattening)."""
         if not self._zero1_active:
             return opt_state
         from .optimizers_sharded import place_zero1_state, shard_zero1_state
         dp_n = self._dp_size()
         return place_zero1_state(
             shard_zero1_state(self.optimizer, params, opt_state, dp_n),
-            self.mesh, dp_n)
+            self.mesh, dp_n, self._data_axis())
 
     def _plan(self, n: int):
         """Resolve (mode, batch_size, num_batches) from the reference's three
@@ -825,19 +948,41 @@ class Trainer:
             # tp/fsdp: place params per their PartitionSpecs BEFORE the
             # optimizer init so mu/nu/etc inherit the same placement
             params = self._place_params(params, pspecs)
-        self._zero1_active = self._resolve_zero1(strategy, pspecs, params)
+        self._zero_stage = self._resolve_zero_stage(strategy, pspecs, params)
+        self._zero1_active = self._zero_stage >= 1
+        self._zero3_template = None
+        self._offload_active = bool(self.sharding is not None
+                                    and self.sharding.offload_opt_state
+                                    and self.mesh is not None)
         opt_shardings = None
+        param_shardings = None
         if self._zero1_active:
-            # ZeRO-1: the state is built in the flat [dp, s]-leaf layout and
+            # ZeRO: the state is built in the flat [dp, s]-leaf layout and
             # physically sharded over dp; the epoch program pins that
-            # placement (opt_shardings) so donation round-trips keep it
+            # placement (opt_shardings) so donation round-trips keep it.
+            # The layout is IDENTICAL for stages 1-3 (init over flat params
+            # == init over standard params), so checkpoints interchange.
             from .optimizers_sharded import (place_zero1_state, sharded_update,
                                              zero1_state_shardings)
             dp_n = self._dp_size()
-            wrapped = sharded_update(self.optimizer, dp_n, "dp")
+            dp_ax = self._data_axis()
+            wrapped = sharded_update(self.optimizer, dp_n, dp_ax)
             opt_state = place_zero1_state(wrapped.init(params), self.mesh,
-                                          dp_n)
-            opt_shardings = zero1_state_shardings(opt_state, self.mesh, dp_n)
+                                          dp_n, dp_ax)
+            opt_shardings = zero1_state_shardings(opt_state, self.mesh, dp_n,
+                                                  dp_ax)
+            if self._zero_stage >= 3:
+                # ZeRO-3: params live at rest in the flat [dp, s] layout,
+                # row-sharded like the opt state; the standard-shape
+                # template drives the JIT gather and checkpoint conversion
+                from .optimizers_sharded import (shard_zero3_params,
+                                                 zero3_param_shardings)
+                self._zero3_template = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+                params = shard_zero3_params(params, dp_n)
+                param_shardings = zero3_param_shardings(params, self.mesh,
+                                                        dp_n, dp_ax)
+                params = jax.tree.map(jax.device_put, params, param_shardings)
         else:
             opt_state = self.optimizer.init(params)
 
@@ -849,9 +994,10 @@ class Trainer:
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
             # host-side structural template, captured BEFORE any donation can
             # invalidate device buffers (restore-after-failure needs it)
+            std_p = self._params_to_ckpt(params)
             ckpt_like = jax.tree.map(
-                np.asarray, _ckpt_state(params,
-                                        self._opt_to_ckpt(params, opt_state),
+                np.asarray, _ckpt_state(std_p,
+                                        self._opt_to_ckpt(std_p, opt_state),
                                         0, rng, rng_impl=self.rng_impl))
             state = self._ckpt_restore(ckpt_mgr, ckpt_like)
             if state is not None:
@@ -863,6 +1009,9 @@ class Trainer:
                     # opt state re-places lazily via inferred shardings on
                     # the first compiled step after resume)
                     params = self._place_params(params, pspecs)
+                # checkpoints hold the STANDARD layout; stage 3 re-flattens
+                # and re-shards for THIS mesh's dp size
+                params = self._params_from_ckpt(params)
                 start_epoch = int(state["epoch"])
                 rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 logger.info("resumed from checkpoint at epoch %d", start_epoch)
@@ -898,20 +1047,23 @@ class Trainer:
         if strategy != "default":
             step_fn = self._make_strategy_step(strategy, task, batch)
         elif self._zero1_active:
-            step_fn = self._make_zero1_step()
+            step_fn = self._make_zero_step(
+                param_template=self._zero3_template)
         else:
             step_fn = None
         k = total_epochs - start_epoch
         # span tracing joins the needs-per-epoch-host-control set: the fused
         # program is one opaque dispatch with no step boundaries to time
+        # (and opt-state offload needs the host hop between epoch calls)
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
-                and not self.halt_on_nan and stats is None):
+                and not self.halt_on_nan and stats is None
+                and not self._offload_active):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
                     pspecs is not None, strategy,
                     self.pp_schedule, self.pp_microbatches,
-                    self._zero1_active)
+                    self._zero_stage)
             if fkey not in self._epoch_cache:
                 loss_fn = make_loss_fn(self.model, self.input_name,
                                        self.label_name)
@@ -919,7 +1071,9 @@ class Trainer:
                     loss_fn, self.optimizer, batch, num_batches, mode,
                     self.shuffle_per_iter, k, self.mesh, n_real=n,
                     infer_params=pspecs is not None, step_fn=step_fn,
-                    opt_shardings=opt_shardings)
+                    opt_shardings=opt_shardings,
+                    param_shardings=param_shardings,
+                    sharding=self.sharding)
             erngs = []
             for _ in range(k):
                 rng, erng = jax.random.split(rng)
@@ -932,6 +1086,7 @@ class Trainer:
             if strategy == "pp":
                 from .parallel.pp import merge_stage_params
                 params = merge_stage_params(self.model, params)
+            params = self._params_to_ckpt(params)
             self.params = params
             self._last_opt_state = opt_state
             epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
@@ -942,15 +1097,18 @@ class Trainer:
         cache_key = (batch, num_batches, mode, self.shuffle_per_iter,
                      n if mode == "stochastic" else None, pspecs is not None,
                      strategy, self.pp_schedule, self.pp_microbatches,
-                     self._zero1_active)
+                     self._zero_stage)
         if cache_key not in self._epoch_cache:
             loss_fn = make_loss_fn(self.model, self.input_name, self.label_name)
             self._epoch_cache[cache_key] = make_epoch_fn(
                 loss_fn, self.optimizer, batch, num_batches, mode,
                 self.shuffle_per_iter, self.mesh, n_real=n,
                 infer_params=pspecs is not None, step_fn=step_fn,
-                opt_shardings=opt_shardings)
+                opt_shardings=opt_shardings,
+                param_shardings=param_shardings, sharding=self.sharding)
         epoch_fn = self._epoch_cache[cache_key]
+        if self._offload_active:
+            epoch_fn = self._wrap_offload(epoch_fn, opt_shardings)
 
         if stats is not None:
             # compile-vs-steady detection: the core trace probes record
@@ -983,9 +1141,10 @@ class Trainer:
                             # labeling below start_epoch would regress the
                             # checkpoint
                             at = max(it, start_epoch)
+                            std_p = self._params_to_ckpt(params)
                             ckpt_mgr.save(
-                                at, _ckpt_state(params,
-                                                self._opt_to_ckpt(params, opt_state),
+                                at, _ckpt_state(std_p,
+                                                self._opt_to_ckpt(std_p, opt_state),
                                                 at, rng, rng_impl=self.rng_impl))
                             logger.warning(
                                 "preempted: checkpoint saved at epoch %d", at)
@@ -1071,10 +1230,11 @@ class Trainer:
                             with (stats.phase("checkpoint")
                                   if stats is not None
                                   else contextlib.nullcontext()):
+                                std_p = self._params_to_ckpt(params)
                                 ckpt_mgr.save(
                                     it, _ckpt_state(
-                                        params,
-                                        self._opt_to_ckpt(params, opt_state),
+                                        std_p,
+                                        self._opt_to_ckpt(std_p, opt_state),
                                         it, rng, rng_impl=self.rng_impl))
                         if stats is not None:
                             stats.end_step(compiled=step_compiled)
@@ -1095,6 +1255,7 @@ class Trainer:
                 params = jax.tree.map(jnp.asarray, state["params"])
                 opt_state = self._opt_from_ckpt(
                     params, jax.tree.map(jnp.asarray, state["opt_state"]))
+                params = self._params_from_ckpt(params)
                 start_epoch = int(state["epoch"])
                 rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 # epochs past the restore point will re-run: drop their losses
@@ -1133,6 +1294,7 @@ class Trainer:
         if strategy == "pp":
             from .parallel.pp import merge_stage_params
             params = merge_stage_params(self.model, params)
+        params = self._params_to_ckpt(params)
         self.params = params
         self._last_opt_state = opt_state
         epoch_keys = sorted(loss_by_it)
@@ -1318,25 +1480,50 @@ class Trainer:
             # streaming honors tp/fsdp sharding exactly like fit(): place
             # params first so the optimizer state inherits the placement
             params = self._place_params(params, pspecs)
-        self._zero1_active = self._resolve_zero1("default", pspecs, params)
+        self._zero_stage = self._resolve_zero_stage("default", pspecs, params)
+        self._zero1_active = self._zero_stage >= 1
+        self._zero3_template = None
+        self._offload_active = bool(self.sharding is not None
+                                    and self.sharding.offload_opt_state
+                                    and self.mesh is not None)
+        opt_shardings = None
         if self._zero1_active:
-            # same zero1 wiring as fit(): sharded state, reduce_scatter step
-            # (make_dp_zero1_train_step has make_train_step's signature)
-            from .optimizers_sharded import place_zero1_state, sharded_update
-            from .parallel.dp import make_dp_zero1_train_step
+            # same zero wiring as fit(): sharded state, reduce_scatter step
+            # (make_dp_train_step has make_train_step's signature)
+            from .optimizers_sharded import (place_zero1_state, sharded_update,
+                                             zero1_state_shardings)
+            from .parallel.dp import make_dp_train_step
             dp_n = self._dp_size()
-            wrapped = sharded_update(self.optimizer, dp_n, "dp")
+            dp_ax = self._data_axis()
+            wrapped = sharded_update(self.optimizer, dp_n, dp_ax)
             opt_state = place_zero1_state(wrapped.init(params), self.mesh,
-                                          dp_n)
-            step = make_dp_zero1_train_step(
+                                          dp_n, dp_ax)
+            opt_shardings = zero1_state_shardings(opt_state, self.mesh, dp_n,
+                                                  dp_ax)
+            if self._zero_stage >= 3:
+                from .optimizers_sharded import (shard_zero3_params,
+                                                 zero3_param_shardings)
+                self._zero3_template = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+                params = shard_zero3_params(params, dp_n)
+                params = jax.tree.map(
+                    jax.device_put, params,
+                    zero3_param_shardings(params, self.mesh, dp_n, dp_ax))
+            step = make_dp_train_step(
                 self.model, self.optimizer, self.mesh, self.input_name,
-                self.label_name)
+                self.label_name, sharding=self._active_cfg(),
+                param_template=self._zero3_template)
         else:
             opt_state = self.optimizer.init(params)
             loss_fn = make_loss_fn(self.model, self.input_name,
                                    self.label_name)
             step = make_train_step(loss_fn, self.optimizer, self.mesh,
-                                   infer_params=pspecs is not None)
+                                   infer_params=pspecs is not None,
+                                   sharding=self.sharding)
+        if self._offload_active:
+            # streaming: the opt state hops host<->device around EVERY step
+            # (there is no fused program to amortize over)
+            step = self._wrap_offload(step, opt_shardings)
 
         ckpt_mgr = None
         start_step = 0
@@ -1347,9 +1534,10 @@ class Trainer:
             # rewind, so previously consumed rows are not replayed)
             from .checkpoint import CheckpointManager
             ckpt_mgr = CheckpointManager(self.checkpoint_dir)
+            std_p = self._params_to_ckpt(params)
             like = jax.tree.map(
-                np.asarray, _ckpt_state(params,
-                                        self._opt_to_ckpt(params, opt_state),
+                np.asarray, _ckpt_state(std_p,
+                                        self._opt_to_ckpt(std_p, opt_state),
                                         0, rng, rng_impl=self.rng_impl))
             state = self._ckpt_restore(ckpt_mgr, like)
             if state is not None:
@@ -1358,6 +1546,7 @@ class Trainer:
                     params, jax.tree.map(jnp.asarray, state["opt_state"]))
                 if pspecs is not None:
                     params = self._place_params(params, pspecs)
+                params = self._params_from_ckpt(params)
                 start_step = int(state["epoch"])
                 rng = self._restore_rng(state["rng"], state.get("rng_impl"))
                 logger.info("fit_stream resumed weights from step %d",
@@ -1381,7 +1570,8 @@ class Trainer:
                     # contract as the in-loop check
                     if ckpt_mgr is not None and not preempt_saved:
                         ckpt_mgr.save(it_count, _ckpt_state(
-                            params, self._opt_to_ckpt(params, opt_state),
+                            self._params_to_ckpt(params),
+                            self._opt_to_ckpt(params, opt_state),
                             it_count, rng, rng_impl=self.rng_impl))
                         logger.warning("preempted: checkpoint saved at "
                                        "stream step %d", it_count)
@@ -1432,7 +1622,7 @@ class Trainer:
                             # caller's iterator factory re-pulls the source)
                             if ckpt_mgr is not None:
                                 ckpt_mgr.save(it_count, _ckpt_state(
-                                    params,
+                                    self._params_to_ckpt(params),
                                     self._opt_to_ckpt(params, opt_state),
                                     it_count, rng, rng_impl=self.rng_impl))
                                 preempt_saved = True
@@ -1469,7 +1659,8 @@ class Trainer:
                         if (ckpt_mgr is not None and self.checkpoint_every > 0
                                 and it_count % self.checkpoint_every == 0):
                             ckpt_mgr.save(it_count, _ckpt_state(
-                                params, self._opt_to_ckpt(params, opt_state),
+                                self._params_to_ckpt(params),
+                                self._opt_to_ckpt(params, opt_state),
                                 it_count, rng, rng_impl=self.rng_impl))
                     feeder.join()
                     if nan_halted:
@@ -1481,6 +1672,7 @@ class Trainer:
                     q.close()
         params = jax.block_until_ready(params)
         wall = time.perf_counter() - t0
+        params = self._params_to_ckpt(params)
         self.params = params
         self._last_opt_state = opt_state
         step_losses = [float(l) for l in losses]
