@@ -12,9 +12,13 @@ Run via ``make decode-smoke`` (or directly). The script
 3. asserts every response echoed its originating ``X-Request-Id``, returned
    the requested token budget (``finish_reason == "length"``), and that the
    greedy requests are deterministic across repeats;
-4. checks the server's ``/healthz`` decode block reports **zero**
-   steady-state retraces after the burst;
-5. SIGTERMs the server mid-burst of a second wave and asserts the drain is
+4. fires a shared-prefix burst (every client the same 24-token system
+   prompt, distinct tails) and asserts the server's prefix cache actually
+   shared pages (hit rate > 0) AND that every response is token-identical
+   to a locally rebuilt engine with sharing disabled and no chunking;
+5. checks the server's ``/healthz`` decode block reports **zero**
+   steady-state retraces after the bursts;
+6. SIGTERMs the server mid-burst of a second wave and asserts the drain is
    clean: in-flight generations complete, the process exits 0.
 
 Everything runs on CPU (``JAX_PLATFORMS=cpu``) in under a minute.
@@ -53,7 +57,8 @@ def make_generate_batcher() -> ContinuousBatcher:
                                max_len=64, dropout=0.0)
     model = model_from_json(spec)
     params = model.init(jax.random.PRNGKey(0))
-    engine = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0)
+    engine = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                          prefill_chunk=8)
     return ContinuousBatcher(engine, max_queue=64)
 
 
@@ -161,10 +166,61 @@ def main() -> None:
                                 temperature=0.0)
         assert again["tokens"] == want, (again["tokens"], want)
 
+        # shared-prefix burst: every client sends the same 24-token system
+        # prompt with a distinct 4-token tail — the server's prefix cache
+        # must share the system pages (hit rate > 0) and its chunked
+        # prefill must split the cold 28-token prompts, all while staying
+        # greedy-exact (checked against a sharing-off engine below)
+        SYS = [(i * 7 + 5) % VOCAB for i in range(24)]
+        shared_results = {}
+
+        def shared_worker(k: int) -> None:
+            c = ServingClient(url, timeout=120, retries=2)
+            for j in range(3):
+                tail = [(k * 11 + j * 3 + i + 1) % VOCAB for i in range(4)]
+                try:
+                    r = c.generate(SYS + tail, max_new_tokens=6,
+                                   temperature=0.0)
+                    shared_results[tuple(SYS + tail)] = r["tokens"]
+                except Exception as exc:  # noqa: BLE001
+                    errors.append((f"shared-{k}-{j}", exc))
+            c.close()
+
+        sthreads = [threading.Thread(target=shared_worker, args=(k,))
+                    for k in range(WORKERS)]
+        for t in sthreads:
+            t.start()
+        for t in sthreads:
+            t.join(timeout=300)
+        assert not errors, (f"{len(errors)} shared-prefix failures, "
+                            f"first: {errors[:3]}")
+
         health = client.healthz()
         dec = health["decode"]["engine"]
         assert dec["steady_traces"] == 0, \
             f"decode retraced after warmup: {dec}"
+        kv = dec["kv"]
+        assert kv["prefix_hits"] > 0, \
+            f"shared-prefix burst produced no prefix hits: {kv}"
+
+        # greedy parity with sharing disabled: the same deterministic
+        # engine rebuilt locally with prefix_cache off and no chunking
+        # must emit identical tokens for every shared-prefix request
+        spec = build_registry_spec("transformer_lm", vocab_size=VOCAB,
+                                   hidden=32, num_layers=2, num_heads=4,
+                                   mlp_dim=64, max_len=64, dropout=0.0)
+        ref_model = model_from_json(spec)
+        ref_params = ref_model.init(jax.random.PRNGKey(0))
+        ref_cb = ContinuousBatcher(
+            DecodeEngine(ref_model, ref_params, num_slots=4, page_size=8,
+                         seed=0, prefix_cache=False), max_queue=64)
+        try:
+            for sp, want_toks in shared_results.items():
+                r = ref_cb.generate(list(sp), max_new_tokens=6, timeout=120)
+                assert r["tokens"] == want_toks, \
+                    (sp[-4:], r["tokens"], want_toks)
+        finally:
+            ref_cb.close()
         toks = sum(3 + (5 * k + j) % 15 for k in range(WORKERS)
                    for j in range(REQUESTS_PER_WORKER))
 
@@ -213,7 +269,9 @@ def main() -> None:
             f"server exited {proc.returncode} on SIGTERM drain"
         print(f"decode-smoke OK: {total} mixed-length generations "
               f"({toks} tokens in {elapsed:.1f}s), every X-Request-Id "
-              f"echoed, 0 steady-state retraces, clean SIGTERM drain",
+              f"echoed, {len(shared_results)} shared-prefix generations "
+              f"({kv['prefix_hits']} prefix hits) greedy-exact vs sharing "
+              f"off, 0 steady-state retraces, clean SIGTERM drain",
               flush=True)
     finally:
         if proc.poll() is None:

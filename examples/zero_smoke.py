@@ -30,6 +30,10 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from sparkflow_tpu.utils.hw import ensure_live_backend
+
+ensure_live_backend()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

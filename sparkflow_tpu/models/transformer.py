@@ -199,6 +199,28 @@ class _TransformerBase(RegistryModel):
         y = self._proj(bp, "fc2_", y)
         return x + y, cache
 
+    def _block_suffix(self, bp, x, layer, cache, start, attend):
+        """One block applied to a multi-token prompt *suffix* ``x``
+        [B,S,hidden] whose first token sits at absolute position ``start``
+        [B]; attention over (committed history ++ this chunk) is delegated to
+        ``attend(layer, q, k_new, v_new, cache, start)`` with q/k/v
+        ``[B, heads, S, d]``. Same projections/norms/residuals as
+        :meth:`_block` — the architecture is defined once."""
+        b, s, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = self._proj(bp, "qkv_", y)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
+        q, k, v = qkv[0], qkv[1], qkv[2]                   # [B, heads, S, d]
+        att, cache = attend(layer, q, k, v, cache, start)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
+        att = self._proj(bp, "o_", att)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y = jax.nn.gelu(self._proj(bp, "fc1_", y))
+        y = self._proj(bp, "fc2_", y)
+        return x + y, cache
+
     def _block_aux(self, bp, x, mask, causal, train, rng):
         """Block step that also returns an auxiliary-loss contribution (zero
         for dense blocks; the MoE mixin overrides this with router aux)."""
@@ -388,6 +410,40 @@ class TransformerLM(_TransformerBase):
         logits = jnp.matmul(x_last.astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
         return logits, kvs
+
+    def prefill_suffix(self, params, ids, start, cache, attend, lengths=None):
+        """Prefill a prompt **suffix**: like :meth:`prefill` but the first
+        token of ``ids`` [B,S] sits at absolute position ``start`` [B] int32
+        (position embeddings offset accordingly) and attention over the
+        already-committed prefix K/V is delegated to
+        ``attend(layer, q, k, v, cache, start) -> (att [B,heads,S,d], cache)``
+        — the cache owner defines the layout (the serving engine writes the
+        chunk's K/V into pool pages and attends over the whole page table).
+        This is what makes shared-prefix caching and chunked prefill work:
+        only the un-shared / not-yet-committed tokens are ever forwarded.
+        Returns ``(logits [B, vocab] at the last valid suffix position,
+        cache)``; ``lengths`` [B] counts valid suffix tokens (default S)."""
+        ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        start = start.astype(jnp.int32)
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        posemb = jnp.take(params["embed"]["pos"],
+                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
+        x = self.cast(x + posemb)
+        for i in range(self.num_layers):
+            x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
+                                          start, attend)
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        if lengths is None:
+            last = jnp.full((b,), s - 1, jnp.int32)
+        else:
+            last = jnp.clip(lengths.astype(jnp.int32) - 1, 0, s - 1)
+        x_last = x[jnp.arange(b), last]
+        logits = jnp.matmul(x_last.astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return logits, cache
 
     def _loss(self, params, feeds, train, rng):
         ids = feeds["input_ids"].astype(jnp.int32)

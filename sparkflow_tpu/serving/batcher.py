@@ -532,7 +532,9 @@ class ContinuousBatcher:
         if not self._pending:
             return None
         req = self._pending[0]
-        if not self.engine.can_admit(len(req.prompt), req.max_new_tokens):
+        # the actual prompt tokens let prefix-cache hits shrink the demand
+        if not self.engine.can_admit(len(req.prompt), req.max_new_tokens,
+                                     prompt=req.prompt):
             return None
         self._pending.pop(0)
         self._prefilling += 1
@@ -556,9 +558,20 @@ class ContinuousBatcher:
             if not req.future.cancelled():
                 req.future.set_exception(exc)
             return
-        req.prefill_done_at = time.perf_counter()
         req.slot = info["slot"]
-        req.tokens.append(info["token"])
+        tok = info.get("token")
+        if tok is None:
+            # chunked prefill: the suffix advances inside the decode loop's
+            # fused steps; the first token arrives via step() like any other
+            # (prefill_done_at is stamped when it does)
+            self.metrics.incr("serving/decode/admitted")
+            with self._cond:
+                self._prefilling -= 1
+                self._active[req.slot] = req
+                self._cond.notify_all()
+            return
+        req.prefill_done_at = time.perf_counter()
+        req.tokens.append(tok)
         self.metrics.incr("serving/decode/admitted")
         with self._cond:
             self._prefilling -= 1
@@ -606,6 +619,9 @@ class ContinuousBatcher:
                 req = self._active.get(slot)
                 if req is None:
                     continue
+                if req.prefill_done_at is None:
+                    # chunked request's first token: TTFT stamps here
+                    req.prefill_done_at = time.perf_counter()
                 req.tokens.append(tok)
                 if (req.eos_id is not None and tok == req.eos_id):
                     finished.append((req, "eos"))

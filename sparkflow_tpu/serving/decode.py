@@ -32,6 +32,27 @@ Sampling is on-device, per slot, under an explicit PRNG key chain
 ``temperature == 0``, temperature + optional top-k otherwise (``top_k`` is
 per-slot dynamic up to the static ``max_top_k`` compiled into the step).
 
+Two prefill-cost optimizations ride on the paged indirection:
+
+- **Shared-prefix caching** (``prefix_cache=True``): :meth:`prefill` hands the
+  actual prompt tokens to the pool, which maps any indexed page-aligned
+  prefix straight into the slot's table
+  (:meth:`~sparkflow_tpu.serving.kvcache.PagedKVCache.alloc`). Only the
+  un-shared suffix is forwarded, through a fixed-shape AOT **suffix
+  executable** (``TransformerLM.prefill_suffix`` + a pool-writing attend);
+  pages publish to the index only after their K/V is committed on device
+  (``commit_prefix``). Greedy output is invariant to sharing — shared pages
+  hold exactly the K/V the ladder would have recomputed.
+- **Chunked prefill** (``prefill_chunk=N``): a prompt suffix longer than N
+  no longer runs as one blocking ladder call. The slot is admitted
+  immediately and its suffix advances one N-token chunk per :meth:`step`,
+  **fused with the decode step in one device call** (one more AOT shape, not
+  a ladder) — in-flight slots keep their token cadence while the long prompt
+  streams in. Until its last chunk commits, the slot is masked out of the
+  decode lanes (table row/position/token -> scratch page 0) so the
+  fixed-shape step cannot touch half-committed pages; its first token is
+  sampled at the final chunk and surfaces through :meth:`step`'s result.
+
 The engine is mechanism only — slot admission at token boundaries, queueing,
 futures and drain semantics live in
 :class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher`.
@@ -94,12 +115,22 @@ class DecodeEngine:
     max_top_k : int
         Static top-k ceiling compiled into the sampler; per-request
         ``top_k`` values clamp to it.
+    prefill_chunk : int | None
+        Enable chunked prefill: prompt suffixes longer than this advance one
+        chunk per :meth:`step`, fused with the decode step in one device
+        call. None (default) keeps the blocking ladder/suffix prefill.
+    prefix_cache : bool
+        Enable shared-prefix KV caching (on by default): prompts share
+        page-aligned prefix K/V through the pool's refcounted prefix index
+        and only prefill their un-shared suffix.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  max_seq_len: Optional[int] = None, max_top_k: int = 64,
                  seed: int = 0, warmup: bool = True,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -134,6 +165,14 @@ class DecodeEngine:
             self.page_size, self.page_size * (self.max_seq_len
                                               // self.page_size))
         self.max_prompt_len = self.prefill_buckets[-1]
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk: Optional[int] = None
+        if prefill_chunk:
+            self.prefill_chunk = max(1, min(int(prefill_chunk),
+                                            self.max_prompt_len))
+        # static width of the suffix/fused executables: the chunk size when
+        # chunking, else one page (prefix-hit suffixes are typically short)
+        self._chunk_width = self.prefill_chunk or self.page_size
 
         if isinstance(params, (list, tuple)):
             from ..graphdef import list_to_params
@@ -150,15 +189,23 @@ class DecodeEngine:
         self._last_token = np.zeros(self.num_slots, np.int32)
         self._temp = np.zeros(self.num_slots, np.float32)
         self._topk = np.zeros(self.num_slots, np.int32)
+        # slots mid-chunked-prefill are kv-active but not decode-ready: the
+        # fixed-shape step masks them to scratch until their K/V is committed
+        self._decode_ready = np.zeros(self.num_slots, bool)
+        self._pending: List[Dict[str, Any]] = []  # chunked-prefill states
 
         self._lock = threading.Lock()
         # expected traces: one per prefill bucket + decode + prefill sampler
+        # + suffix prefill (+ the fused chunk/decode step when chunking)
         self.recompile_guard = RecompileGuard(
             name="serving.decode",
-            warn_after=len(self.prefill_buckets) + 2)
+            warn_after=len(self.prefill_buckets) + 3
+            + (1 if self.prefill_chunk else 0))
         self._prefill_exes: Dict[int, Any] = {}
         self._decode_exe: Any = None
         self._sample_exe: Any = None
+        self._suffix_exe: Any = None
+        self._fused_exe: Any = None
         self.aot_compiles = 0
         self._steps = 0
         self._tokens_out = 0
@@ -225,6 +272,67 @@ class DecodeEngine:
 
         return prefill
 
+    def _suffix_fn(self):
+        """Fixed-shape suffix prefill: forward one ``_chunk_width``-token
+        chunk of a prompt whose first ``start`` tokens' K/V is already
+        committed in the slot's pages (shared prefix and/or earlier chunks),
+        writing the chunk's K/V into the slot's pages and attending over the
+        whole history through the page table. One batch row — chunks are
+        per-slot events, the decode hot path stays the pallas kernel."""
+        model, page, C = self.model, self.page_size, self._chunk_width
+        maxp = self.max_pages_per_slot
+        heads, hd = model.num_heads, model.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        j = jnp.arange(C, dtype=jnp.int32)
+        tpos = jnp.arange(maxp * page, dtype=jnp.int32)
+
+        def suffix_prefill(params, k_pool, v_pool, ids, start, valid, ctable):
+            def attend(layer, q, k_new, v_new, cache, st):
+                kp, vp = cache
+                pos_abs = st[0] + j                            # [C] absolute
+                pids = ctable[jnp.clip(pos_abs // page, 0, maxp - 1)]
+                pids = jnp.where(j < valid[0], pids, 0)        # pad -> scratch
+                off = pos_abs % page
+                kc = jnp.transpose(k_new[0], (1, 0, 2))        # [C, heads, d]
+                vc = jnp.transpose(v_new[0], (1, 0, 2))
+                kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
+                vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
+                # gather the row's pages in logical order: element l of the
+                # flattened gather sits at absolute position l
+                hk = kp[layer, ctable].reshape(maxp * page, heads, hd)
+                hv = vp[layer, ctable].reshape(maxp * page, heads, hd)
+                s = jnp.einsum("hcd,lhd->hcl", q[0].astype(jnp.float32),
+                               hk.astype(jnp.float32)) * scale
+                ok = tpos[None, :] <= pos_abs[:, None]         # causal [C, L]
+                s = jnp.where(ok[None, :, :], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("hcl,lhd->hcd", p, hv.astype(jnp.float32))
+                return out[None].astype(q.dtype), (kp, vp)
+
+            logits, (k_pool, v_pool) = model.prefill_suffix(
+                params, ids, start, (k_pool, v_pool), attend, lengths=valid)
+            return logits, k_pool, v_pool
+
+        return suffix_prefill
+
+    def _fused_fn(self):
+        """Chunked prefill's device call: one suffix chunk + the regular
+        fixed-shape decode step, fused so in-flight slots pay one dispatch —
+        not a prefill stall — while a long prompt streams in."""
+        body = self._suffix_fn()
+        decode = self._decode_fn
+
+        def fused(params, k_pool, v_pool, ids, start, valid, ctable,
+                  token, pos, table, keys, temp, topk):
+            logits, k_pool, v_pool = body(params, k_pool, v_pool, ids,
+                                          start, valid, ctable)
+            tok, k_pool, v_pool, keys = decode(params, k_pool, v_pool,
+                                               token, pos, table, keys,
+                                               temp, topk)
+            return logits, tok, k_pool, v_pool, keys
+
+        return fused
+
     def _param_struct(self):
         return jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
@@ -283,26 +391,64 @@ class DecodeEngine:
                         jax.ShapeDtypeStruct((b // self.page_size,),
                                              i32)).compile()
             self.aot_compiles += 1
+        C = self._chunk_width
+        chunk_structs = (
+            jax.ShapeDtypeStruct((1, C), i32),       # ids
+            jax.ShapeDtypeStruct((1,), i32),         # start
+            jax.ShapeDtypeStruct((1,), i32),         # valid
+            jax.ShapeDtypeStruct((maxp,), i32))      # slot's table row
+        if self._suffix_exe is None:
+            with annotate("serving/decode_compile_suffix"):
+                self._suffix_exe = jax.jit(
+                    guard.wrap(self._suffix_fn()),
+                    donate_argnums=(1, 2)).lower(
+                        ps, pool, pool, *chunk_structs).compile()
+            self.aot_compiles += 1
+        if self.prefill_chunk and self._fused_exe is None:
+            with annotate("serving/decode_compile_fused"):
+                self._fused_exe = jax.jit(
+                    guard.wrap(self._fused_fn()),
+                    donate_argnums=(1, 2)).lower(
+                        ps, pool, pool, *chunk_structs,
+                        jax.ShapeDtypeStruct((B,), i32),
+                        jax.ShapeDtypeStruct((B,), i32),
+                        jax.ShapeDtypeStruct((B, maxp), i32),
+                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                        jax.ShapeDtypeStruct((B,), jnp.float32),
+                        jax.ShapeDtypeStruct((B,), i32)).compile()
+            self.aot_compiles += 1
         guard.mark_steady()
 
     # -- admission / prefill -------------------------------------------------
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt: Optional[Sequence[int]] = None) -> bool:
         """Token-boundary admission check: a free slot exists and the pool
-        can reserve the request's worst case."""
+        can reserve the request's worst case. With the actual ``prompt``
+        tokens (and prefix caching on), indexed prefix pages are subtracted
+        from the demand — the exact mirror of :meth:`prefill`'s alloc."""
         if not (1 <= prompt_len <= self.max_prompt_len):
             return False
         total = prompt_len + max(1, int(max_new_tokens))
         if total > self.max_seq_len:
             return False
-        return self.kv.can_admit(total)
+        return self.kv.can_admit(
+            total, list(prompt) if (prompt is not None
+                                    and self.prefix_cache) else None)
 
     def prefill(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
                 temperature: float = 0.0, top_k: int = 0,
                 seed: Optional[int] = None) -> Dict[str, Any]:
-        """Admit one sequence: allocate a slot + pages, run the bucketed
-        prefill (committing K/V into the pool on-device), sample the first
-        token. Returns ``{"slot", "token", "prompt_len"}``; raises
+        """Admit one sequence: allocate a slot + pages (mapping any indexed
+        shared prefix straight into the table), prefill what isn't shared —
+        the bucketed ladder for cold prompts, the suffix executable for
+        prefix hits — and sample the first token. With chunked prefill
+        enabled and a suffix longer than ``prefill_chunk``, the call returns
+        immediately with ``token=None``; the suffix advances one chunk per
+        :meth:`step` and the first token surfaces there.
+
+        Returns ``{"slot", "token", "prompt_len", "shared_tokens",
+        "chunked"}``; raises
         :class:`~sparkflow_tpu.serving.kvcache.OutOfPages` when the request
         cannot be admitted right now (backpressure)."""
         prompt = list(int(t) for t in prompt)
@@ -318,25 +464,45 @@ class DecodeEngine:
             slot = self.kv.free_slot()
             if slot is None:
                 raise OutOfPages("no free decode slot")
-            self.kv.alloc(slot, n, total)  # raises OutOfPages when full
+            shared_pages, _saved = self.kv.alloc(
+                slot, prompt if self.prefix_cache else n, total)
             t0 = time.perf_counter()
-            bucket = next(b for b in self.prefill_buckets if n <= b)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :n] = prompt
-            npages = bucket // self.page_size
-            page_ids = np.zeros(npages, np.int32)  # pad -> scratch page 0
-            held = self.kv.pages_for(n, self.page_size)
-            page_ids[:held] = self.kv.page_tables()[slot, :held]
-            exe = self._prefill_exes[bucket]
-            with obs_span("serving/decode_prefill",
-                          args={"bucket": bucket, "slot": int(slot)},
-                          jax_annotation=True):
-                logits, self._k_pool, self._v_pool = exe(
-                    self._params, self._k_pool, self._v_pool, ids,
-                    np.asarray([n], np.int32), page_ids)
+            start = shared_pages * self.page_size  # first un-shared position
+            self._temp[slot] = float(temperature)
+            self._topk[slot] = min(int(top_k), self.max_top_k)
+            self._decode_ready[slot] = False
             if seed is not None:
                 self._keys = self._keys.at[slot].set(
                     jax.random.PRNGKey(int(seed)))
+            self._prefills += 1
+            self.metrics.observe("serving/decode/prompt_tokens", n)
+            if self.prefill_chunk is not None and n - start > self.prefill_chunk:
+                # chunked admission: the suffix rides the decode loop, one
+                # fused chunk per step; nothing blocks here
+                self._pending.append({"slot": int(slot), "prompt": prompt,
+                                      "next": start, "end": n,
+                                      "seed": seed, "t0": t0})
+                return {"slot": int(slot), "token": None, "prompt_len": n,
+                        "shared_tokens": start, "chunked": True}
+            if start == 0:
+                bucket = next(b for b in self.prefill_buckets if n <= b)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :n] = prompt
+                npages = bucket // self.page_size
+                page_ids = np.zeros(npages, np.int32)  # pad -> scratch page 0
+                held = self.kv.pages_for(n, self.page_size)
+                page_ids[:held] = self.kv.page_tables()[slot, :held]
+                exe = self._prefill_exes[bucket]
+                with obs_span("serving/decode_prefill",
+                              args={"bucket": bucket, "slot": int(slot)},
+                              jax_annotation=True):
+                    logits, self._k_pool, self._v_pool = exe(
+                        self._params, self._k_pool, self._v_pool, ids,
+                        np.asarray([n], np.int32), page_ids)
+            else:
+                logits = self._suffix_prefill_locked(slot, prompt, start, n)
+            if self.prefix_cache:
+                self.kv.commit_prefix(slot, prompt)  # K/V is on device now
             tok, key = self._sample_exe(
                 np.asarray(logits), self._keys[slot][None],
                 np.asarray([temperature], np.float32),
@@ -344,60 +510,140 @@ class DecodeEngine:
             self._keys = self._keys.at[slot].set(key[0])
             first = int(np.asarray(tok)[0])
             self._last_token[slot] = first
-            self._temp[slot] = float(temperature)
-            self._topk[slot] = min(int(top_k), self.max_top_k)
-            self._prefills += 1
+            self._decode_ready[slot] = True
             self.metrics.observe("serving/decode/prefill_ms",
                                  (time.perf_counter() - t0) * 1000.0)
-            self.metrics.observe("serving/decode/prompt_tokens", n)
-        return {"slot": int(slot), "token": first, "prompt_len": n}
+        return {"slot": int(slot), "token": first, "prompt_len": n,
+                "shared_tokens": start, "chunked": False}
+
+    def _suffix_prefill_locked(self, slot: int, prompt: List[int],
+                               start: int, n: int):
+        """Synchronous suffix prefill for a prefix-hit prompt: forward
+        ``prompt[start:]`` through the fixed-shape suffix executable in
+        ``_chunk_width`` pieces. Returns the final chunk's logits."""
+        C = self._chunk_width
+        row = self.kv.page_tables()[slot]
+        logits = None
+        p = start
+        while p < n:
+            c = min(C, n - p)
+            ids = np.zeros((1, C), np.int32)
+            ids[0, :c] = prompt[p:p + c]
+            with obs_span("serving/decode_prefill_suffix",
+                          args={"slot": int(slot), "start": int(p)},
+                          jax_annotation=True):
+                logits, self._k_pool, self._v_pool = self._suffix_exe(
+                    self._params, self._k_pool, self._v_pool, ids,
+                    np.asarray([p], np.int32), np.asarray([c], np.int32),
+                    row)
+            p += c
+        return logits
 
     # -- decode --------------------------------------------------------------
 
     def step(self) -> Dict[int, int]:
-        """One decode iteration over every active slot: append a token's
-        page room, run the fixed-shape step, return ``{slot: next_token}``.
-        No-op (empty dict) when nothing is active."""
+        """One decode iteration over every decode-ready slot: append a
+        token's page room, run the fixed-shape step, return
+        ``{slot: next_token}``. Pending chunked prefills advance one chunk
+        here, fused into the same device call; a slot whose final chunk
+        just committed contributes its *first* token to the result. No-op
+        (empty dict) when nothing is active."""
         with self._lock:
             active = self.kv.active_slots()
-            if active.size == 0:
+            ready = np.asarray([int(s) for s in active
+                                if self._decode_ready[s]], np.int64)
+            state = self._pending[0] if self._pending else None
+            if ready.size == 0 and state is None:
                 return {}
             t0 = time.perf_counter()
             # the incoming token occupies position == current length: make
             # sure its page exists, then pass the PRE-append position
-            for s in active:
+            for s in ready:
                 self.kv.append(int(s))
             lengths = self.kv.lengths()
+            table_full = self.kv.page_tables()
+            # mask non-ready lanes (mid-chunked-prefill or idle) to scratch:
+            # the fixed-shape step must not write into half-committed pages
+            mask = np.zeros(self.num_slots, bool)
+            mask[ready] = True
             pos = np.maximum(lengths - 1, 0).astype(np.int32)
-            table = self.kv.page_tables()
-            with obs_span("serving/decode_step",
-                          args={"active": int(active.size)},
-                          jax_annotation=True):
-                tok, self._k_pool, self._v_pool, self._keys = \
-                    self._decode_exe(self._params, self._k_pool,
-                                     self._v_pool, self._last_token, pos,
-                                     table, self._keys, self._temp,
-                                     self._topk)
+            pos[~mask] = 0
+            table = table_full.copy()
+            table[~mask] = 0
+            token = np.where(mask, self._last_token, 0).astype(np.int32)
+            out: Dict[int, int] = {}
+            if state is not None:
+                C = self._chunk_width
+                p, end = state["next"], state["end"]
+                c = min(C, end - p)
+                ids = np.zeros((1, C), np.int32)
+                ids[0, :c] = state["prompt"][p:p + c]
+                with obs_span("serving/decode_fused_step",
+                              args={"active": int(ready.size),
+                                    "slot": state["slot"]},
+                              jax_annotation=True):
+                    logits, tok, self._k_pool, self._v_pool, self._keys = \
+                        self._fused_exe(
+                            self._params, self._k_pool, self._v_pool, ids,
+                            np.asarray([p], np.int32),
+                            np.asarray([c], np.int32),
+                            table_full[state["slot"]], token, pos, table,
+                            self._keys, self._temp, self._topk)
+                state["next"] = p + c
+                if state["next"] >= end:  # final chunk: first token is born
+                    self._pending.pop(0)
+                    slot = state["slot"]
+                    if self.prefix_cache:
+                        self.kv.commit_prefix(slot, state["prompt"])
+                    if state["seed"] is not None:
+                        # the fused steps advanced every lane's key; re-pin
+                        # the requested seed before the first sample
+                        self._keys = self._keys.at[slot].set(
+                            jax.random.PRNGKey(int(state["seed"])))
+                    ftok, key = self._sample_exe(
+                        np.asarray(logits), self._keys[slot][None],
+                        np.asarray([self._temp[slot]], np.float32),
+                        np.asarray([self._topk[slot]], np.int32))
+                    self._keys = self._keys.at[slot].set(key[0])
+                    first = int(np.asarray(ftok)[0])
+                    self._last_token[slot] = first
+                    self._decode_ready[slot] = True
+                    out[int(slot)] = first
+                    self.metrics.observe(
+                        "serving/decode/prefill_ms",
+                        (time.perf_counter() - state["t0"]) * 1000.0)
+            else:
+                with obs_span("serving/decode_step",
+                              args={"active": int(ready.size)},
+                              jax_annotation=True):
+                    tok, self._k_pool, self._v_pool, self._keys = \
+                        self._decode_exe(self._params, self._k_pool,
+                                         self._v_pool, token, pos,
+                                         table, self._keys, self._temp,
+                                         self._topk)
             tok = np.asarray(tok)
-            out = {}
-            for s in active:
+            for s in ready:
                 self._last_token[s] = tok[s]
                 out[int(s)] = int(tok[s])
             self._steps += 1
-            self._tokens_out += int(active.size)
+            self._tokens_out += len(out)
             dt_ms = (time.perf_counter() - t0) * 1000.0
             self.metrics.observe("serving/decode/step_ms", dt_ms)
             self.metrics.observe("serving/decode/step_active",
-                                 int(active.size))
+                                 int(ready.size))
             self.metrics.observe("serving/decode/token_latency_ms",
                                  dt_ms)  # per-token: one step = one token
         return out
 
     def release(self, slot: int) -> None:
         """Retire a finished sequence at a token boundary: its pages return
-        to the pool immediately, the lane is reusable next step."""
+        to the pool immediately (shared pages just drop one reference), the
+        lane is reusable next step."""
         with self._lock:
             self.kv.free(int(slot))
+            self._pending = [st for st in self._pending
+                             if st["slot"] != int(slot)]
+            self._decode_ready[slot] = False
             self._last_token[slot] = 0
             self._temp[slot] = 0.0
             self._topk[slot] = 0
@@ -413,6 +659,9 @@ class DecodeEngine:
                 "num_slots": self.num_slots,
                 "prefill_buckets": list(self.prefill_buckets),
                 "max_seq_len": self.max_seq_len,
+                "prefix_cache": self.prefix_cache,
+                "prefill_chunk": self.prefill_chunk,
+                "pending_prefills": len(self._pending),
                 "aot_compiles": self.aot_compiles,
                 "traces": self.recompile_guard.traces,
                 "steady_traces": self.recompile_guard.steady_traces,

@@ -27,18 +27,52 @@ Unassigned page-table entries point at page 0, a **scratch page** the manager
 never hands out: inactive slots' decode writes land there harmlessly and the
 kernel's index maps always see valid pool indices.
 
-Occupancy and fragmentation export as ``serving/kv/*`` gauges:
-``pages_total`` / ``pages_used`` / ``pages_reserved`` / ``occupancy`` (used /
-usable), ``fragmentation`` (allocated-but-empty token fraction inside used
-pages — internal fragmentation; pages are fixed-size so there is no external
-kind), ``tokens`` and ``slots_active``.
+Shared-prefix caching (copy-on-write page sharing)
+--------------------------------------------------
+Real decode traffic repeats prompt prefixes — system prompts, few-shot
+preambles — and recomputing their K/V per request is the dominant redundant
+cost. The manager therefore keeps a **prefix index**: a hash-chained trie of
+page-aligned prompt blocks (each key is ``blake2b(parent_key ‖ block_tokens)``,
+so a block is only reachable through its exact prefix chain). When
+:meth:`alloc` receives the actual prompt *tokens*, it walks the chain and maps
+every indexed page straight into the new slot's table — no allocation, no
+prefill for those tokens — and returns ``(shared_pages, tokens_saved)``.
+
+Sharing is reference-counted and copy-on-write by construction:
+
+* only **full** pages are shared, and never the page holding the final prompt
+  token (cap ``(len(prompt) - 1) // page_size``) — the consumer always
+  recomputes at least one suffix token (its first-token logits) and all of
+  its writes (suffix prefill and decode appends) land at positions past the
+  shared pages, i.e. in private pages. Divergence therefore never mutates a
+  shared page; "copy"-on-write degenerates to allocate-on-write.
+* :meth:`free` decrements; a page is reclaimed only at refcount 0. Pages that
+  are in the prefix index keep their contents after release in a **cached
+  tier** (LRU) — still evictable supply for admission, but a later prompt
+  with the same prefix revives them for free.
+* a page's contents are published to the index by :meth:`commit_prefix` only
+  **after** the engine has committed the K/V on device — an alloc-time
+  registration would let a concurrent request share pages whose K/V hasn't
+  been written yet.
+* admission stays exact: a prefix hit reduces the worst-case demand by the
+  shared pages (they are mapped, not drawn from the pool), and a shared page
+  is never double-reserved — reservations only cover future *private* pages.
+
+The pallas kernel needs zero changes: aliased page-table entries are just two
+tables pointing at the same pool index.
+
+Occupancy and fragmentation export as ``serving/kv/*`` gauges, and the
+decode-plane summary (occupancy, fragmentation, prefix hit-rate, tokens
+saved) additionally exports under ``decode/*`` for fleet dashboards.
 """
 
 from __future__ import annotations
 
+import hashlib
 import math
 import threading
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -93,6 +127,17 @@ class PagedKVCache:
         self._pages_held = np.zeros(self.num_slots, np.int32)
         self._reserved = np.zeros(self.num_slots, np.int32)  # beyond held
         self._active = np.zeros(self.num_slots, bool)
+        # prefix sharing state: per-page refcounts; chain-hash -> page for
+        # published full prefix blocks; reverse map for deregistration; and
+        # the cached tier — refcount-0 pages whose contents are still indexed
+        # (LRU order; evicted last, after the plain free list is exhausted)
+        self._refcount = np.zeros(self.num_pages, np.int32)
+        self._prefix_index: Dict[bytes, int] = {}
+        self._page_key: Dict[int, bytes] = {}
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        self._tokens_saved = 0
         self._export_gauges_locked()
 
     # -- capacity ------------------------------------------------------------
@@ -107,29 +152,127 @@ class PagedKVCache:
             idle = np.flatnonzero(~self._active)
             return int(idle[0]) if idle.size else None
 
-    def can_admit(self, total_tokens: int) -> bool:
+    def can_admit(self, total_tokens: int,
+                  prompt_tokens: Optional[Sequence[int]] = None) -> bool:
         """Whether a sequence whose worst case is ``total_tokens`` (prompt +
         max new tokens) could be admitted right now: a free slot exists and
-        the un-reserved free pool covers its reservation."""
+        the un-reserved evictable pool covers its reservation. With the
+        actual ``prompt_tokens``, prefix-index hits are subtracted from the
+        demand — the exact mirror of :meth:`alloc`'s accounting."""
         need = self.pages_for(total_tokens, self.page_size)
         if need > self.max_pages_per_slot:
             return False
         with self._lock:
             if not np.any(~self._active):
                 return False
-            return need <= len(self._free) - int(self._reserved.sum())
+            shared = 0
+            revived = 0
+            if prompt_tokens is not None and not isinstance(
+                    prompt_tokens, (int, np.integer)):
+                hits = self._lookup_locked(list(prompt_tokens))
+                shared = len(hits)
+                revived = sum(1 for p in hits if self._refcount[p] == 0)
+            return need - shared <= self._avail_locked() - revived
+
+    # -- prefix index --------------------------------------------------------
+
+    def _block_digests(self, tokens: Sequence[int], limit: int) -> List[bytes]:
+        """Chained digests of the first ``limit`` full page blocks: block i's
+        key commits to every token before it, so equal keys mean equal
+        page-aligned prefixes (up to hash collision)."""
+        out: List[bytes] = []
+        parent = b""
+        ps = self.page_size
+        for i in range(limit):
+            h = hashlib.blake2b(parent, digest_size=16)
+            h.update(np.asarray(tokens[i * ps:(i + 1) * ps],
+                                np.int64).tobytes())
+            parent = h.digest()
+            out.append(parent)
+        return out
+
+    def _lookup_locked(self, tokens: List[int]) -> List[int]:
+        """Longest indexed page chain for ``tokens``'s shareable prefix (full
+        pages only, and never the final prompt token's page)."""
+        limit = max(0, (len(tokens) - 1) // self.page_size)
+        pages: List[int] = []
+        for dg in self._block_digests(tokens, limit):
+            pid = self._prefix_index.get(dg)
+            if pid is None:
+                break
+            pages.append(pid)
+        return pages
+
+    def commit_prefix(self, slot: int, prompt_tokens: Sequence[int]) -> int:
+        """Publish ``slot``'s full-page prompt blocks into the prefix index.
+        Call only once the K/V for those tokens is committed on device — the
+        index is how *other* slots find these pages, so publishing before the
+        write would hand out garbage. Returns the number of newly indexed
+        pages (already-indexed blocks are skipped)."""
+        tokens = [int(t) for t in prompt_tokens]
+        with self._lock:
+            if not self._active[slot]:
+                return 0
+            n = min(len(tokens), int(self._lengths[slot]))
+            added = 0
+            for i, dg in enumerate(self._block_digests(tokens,
+                                                       n // self.page_size)):
+                pid = int(self._tables[slot, i])
+                if pid == 0:
+                    break
+                if self._prefix_index.get(dg) == pid:
+                    continue  # shared from the index in the first place
+                if dg in self._prefix_index or pid in self._page_key:
+                    continue  # block already published by a concurrent twin
+                self._prefix_index[dg] = pid
+                self._page_key[pid] = dg
+                added += 1
+            return added
+
+    def _avail_locked(self) -> int:
+        """Pages available to new demand: the free list plus the evictable
+        cached tier, minus outstanding reservations."""
+        return (len(self._free) + len(self._cached)
+                - int(self._reserved.sum()))
+
+    def _take_page_locked(self) -> int:
+        """Draw one page: plain free list first, then evict the LRU cached
+        page (dropping its index entry — the prefix is simply forgotten)."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            pid, _ = self._cached.popitem(last=False)
+            dg = self._page_key.pop(pid, None)
+            if dg is not None:
+                self._prefix_index.pop(dg, None)
+            return pid
+        raise OutOfPages("page pool exhausted despite reservation "
+                         "(accounting bug)")
 
     # -- lifecycle -----------------------------------------------------------
 
-    def alloc(self, slot: int, prompt_tokens: int, total_tokens: int) -> None:
+    def alloc(self, slot: int, prompt_tokens: Union[int, Sequence[int]],
+              total_tokens: int) -> tuple:
         """Claim ``slot`` for a sequence: allocate pages covering the prompt
         now, reserve (but don't allocate) the rest of the worst case so
         :meth:`append` can never fail later. Raises :class:`OutOfPages` when
-        the reservation doesn't fit."""
-        if prompt_tokens < 1:
+        the reservation doesn't fit.
+
+        ``prompt_tokens`` may be the prompt length (no sharing — the legacy
+        contract) or the actual token sequence, in which case indexed prefix
+        pages are mapped into the table instead of allocated. Returns
+        ``(shared_pages, tokens_saved)`` — ``(0, 0)`` on a miss or when only
+        a length was given."""
+        if isinstance(prompt_tokens, (int, np.integer)):
+            tokens: Optional[List[int]] = None
+            n_prompt = int(prompt_tokens)
+        else:
+            tokens = [int(t) for t in prompt_tokens]
+            n_prompt = len(tokens)
+        if n_prompt < 1:
             raise ValueError("prompt_tokens must be >= 1")
-        total_tokens = max(int(total_tokens), int(prompt_tokens))
-        need_now = self.pages_for(prompt_tokens, self.page_size)
+        total_tokens = max(int(total_tokens), n_prompt)
+        need_now = self.pages_for(n_prompt, self.page_size)
         need_total = self.pages_for(total_tokens, self.page_size)
         if need_total > self.max_pages_per_slot:
             raise OutOfPages(
@@ -138,20 +281,40 @@ class PagedKVCache:
         with self._lock:
             if self._active[slot]:
                 raise ValueError(f"slot {slot} is already active")
-            avail = len(self._free) - int(self._reserved.sum())
-            if need_total > avail:
+            shared: List[int] = []
+            if tokens is not None:
+                shared = self._lookup_locked(tokens)
+                self._prefix_lookups += 1
+                if shared:
+                    self._prefix_hits += 1
+            n_shared = len(shared)
+            # shared pages are mapped, not drawn, so they leave the demand;
+            # cached hits about to be revived leave the evictable supply
+            revived = sum(1 for p in shared if self._refcount[p] == 0)
+            avail = self._avail_locked() - revived
+            if need_total - n_shared > avail:
                 self.metrics.incr("serving/kv/alloc_rejections")
                 raise OutOfPages(
-                    f"need {need_total} pages, {avail} unreserved free "
-                    f"(of {len(self._free)})")
+                    f"need {need_total - n_shared} pages "
+                    f"({n_shared} shared), {avail} unreserved free")
             self._tables[slot, :] = 0
-            for i in range(need_now):
-                self._tables[slot, i] = self._free.pop()
-            self._lengths[slot] = prompt_tokens
+            for i, pid in enumerate(shared):
+                if self._refcount[pid] == 0:
+                    self._cached.pop(pid, None)  # revive from the cached tier
+                self._refcount[pid] += 1
+                self._tables[slot, i] = pid
+            for i in range(n_shared, need_now):
+                pid = self._take_page_locked()
+                self._refcount[pid] = 1
+                self._tables[slot, i] = pid
+            self._lengths[slot] = n_prompt
             self._pages_held[slot] = need_now
             self._reserved[slot] = need_total - need_now
             self._active[slot] = True
+            saved = n_shared * self.page_size
+            self._tokens_saved += saved
             self._export_gauges_locked()
+            return n_shared, saved
 
     def append(self, slot: int, n: int = 1) -> None:
         """Extend ``slot`` by ``n`` tokens, drawing new pages from its
@@ -171,21 +334,33 @@ class PagedKVCache:
                     if self._reserved[slot] <= 0:
                         raise OutOfPages(
                             f"slot {slot} grew past its reservation")
-                    self._tables[slot, held] = self._free.pop()
+                    pid = self._take_page_locked()
+                    self._refcount[pid] = 1
+                    self._tables[slot, held] = pid
                     self._pages_held[slot] += 1
                     self._reserved[slot] -= 1
                 self._lengths[slot] = length + 1
             self._export_gauges_locked()
 
     def free(self, slot: int) -> None:
-        """Retire ``slot``: return its pages (and unused reservation) to the
-        pool. Idempotent."""
+        """Retire ``slot``: drop one reference from each held page; pages
+        reaching refcount 0 return to the pool — straight to the free list,
+        or to the cached tier when the prefix index still knows their
+        contents. Idempotent."""
         with self._lock:
             if not self._active[slot]:
                 return
             held = int(self._pages_held[slot])
             for i in range(held):
-                self._free.append(int(self._tables[slot, i]))
+                pid = int(self._tables[slot, i])
+                self._refcount[pid] -= 1
+                if self._refcount[pid] <= 0:
+                    self._refcount[pid] = 0
+                    if pid in self._page_key:
+                        self._cached[pid] = None
+                        self._cached.move_to_end(pid)
+                    else:
+                        self._free.append(pid)
             self._tables[slot, :] = 0
             self._lengths[slot] = 0
             self._pages_held[slot] = 0
@@ -214,39 +389,67 @@ class PagedKVCache:
         with self._lock:
             return int(self._lengths[slot])
 
+    def refcounts(self) -> np.ndarray:
+        """``[num_pages]`` int32 per-page reference counts (scratch page 0
+        is always 0)."""
+        with self._lock:
+            return self._refcount.copy()
+
     # -- stats ---------------------------------------------------------------
+
+    def _used_frag_locked(self) -> tuple:
+        used = int(np.count_nonzero(self._refcount > 0))
+        tokens = int(self._lengths.sum())
+        # with sharing, logical tokens can exceed distinct-page capacity,
+        # so internal fragmentation clamps at 0
+        frag = (max(0.0, 1.0 - tokens / (used * self.page_size))
+                if used else 0.0)
+        return used, tokens, frag
 
     def _export_gauges_locked(self) -> None:
         usable = self.num_pages - 1
-        used = int(self._pages_held.sum())
-        tokens = int(self._lengths.sum())
-        frag = (1.0 - tokens / (used * self.page_size)) if used else 0.0
+        used, tokens, frag = self._used_frag_locked()
+        occ = used / usable if usable else 0.0
+        hit_rate = (self._prefix_hits / self._prefix_lookups
+                    if self._prefix_lookups else 0.0)
         self.metrics.gauge("serving/kv/pages_total", usable)
         self.metrics.gauge("serving/kv/pages_used", used)
+        self.metrics.gauge("serving/kv/pages_cached", len(self._cached))
         self.metrics.gauge("serving/kv/pages_reserved",
                            int(self._reserved.sum()))
-        self.metrics.gauge("serving/kv/occupancy",
-                           used / usable if usable else 0.0)
+        self.metrics.gauge("serving/kv/occupancy", occ)
         self.metrics.gauge("serving/kv/fragmentation", frag)
         self.metrics.gauge("serving/kv/tokens", tokens)
         self.metrics.gauge("serving/kv/slots_active",
                            int(self._active.sum()))
+        # decode-plane summary for fleet dashboards (obs exporters render
+        # these as decode_* in Prometheus exposition)
+        self.metrics.gauge("decode/occupancy", occ)
+        self.metrics.gauge("decode/fragmentation", frag)
+        self.metrics.gauge("decode/prefix_hit_rate", hit_rate)
+        self.metrics.gauge("decode/tokens_saved", self._tokens_saved)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             usable = self.num_pages - 1
-            used = int(self._pages_held.sum())
-            tokens = int(self._lengths.sum())
+            used, tokens, frag = self._used_frag_locked()
             return {
                 "page_size": self.page_size,
                 "pages_total": usable,
                 "pages_used": used,
-                "pages_free": len(self._free),
+                # evictable supply: plain free pages + cached prefix pages
+                "pages_free": len(self._free) + len(self._cached),
+                "pages_cached": len(self._cached),
                 "pages_reserved": int(self._reserved.sum()),
                 "occupancy": used / usable if usable else 0.0,
-                "fragmentation": (1.0 - tokens / (used * self.page_size)
-                                  if used else 0.0),
+                "fragmentation": frag,
                 "tokens": tokens,
                 "slots_active": int(self._active.sum()),
                 "num_slots": self.num_slots,
+                "prefix_lookups": self._prefix_lookups,
+                "prefix_hits": self._prefix_hits,
+                "prefix_hit_rate": (self._prefix_hits / self._prefix_lookups
+                                    if self._prefix_lookups else 0.0),
+                "prefix_blocks_indexed": len(self._prefix_index),
+                "tokens_saved": self._tokens_saved,
             }

@@ -24,9 +24,13 @@ compose:
   leaves the rotation immediately and re-enters only when its ``/healthz``
   goes green again (i.e. after a restart).
 
-Dispatch picks the **least-loaded** live replica: lowest router-side
-in-flight counter, tie-broken by the probe-reported replica-side queue
-depth. All mutable state (health flags, counters, load figures) is guarded
+Dispatch picks the **least-loaded** live replica: for predict traffic the
+lowest router-side in-flight counter, tie-broken by the probe-reported
+replica-side queue depth; for ``/v1/generate`` traffic the load signal is
+**KV headroom** (``free_slots`` / ``pages_free`` from the probe body's
+``decode`` block) — a decode replica's capacity is pages, not queue length,
+so page-starved replicas sort last while still serving predict normally.
+All mutable state (health flags, counters, load figures) is guarded
 by one ``Membership._lock``; per-replica gauges are published to a
 ``utils.metrics`` registry so ``GET /metrics?format=prometheus`` on the
 router exposes the whole fleet (``router/replica<i>/...``).
@@ -168,6 +172,10 @@ class Replica:
         self.inflight = 0            # router-side dispatches in flight
         self.queue_depth = 0         # replica-reported, from /healthz
         self.reported_in_flight = 0  # replica-reported, from /healthz
+        # decode-plane KV headroom, from /healthz's "decode" block; -1 =
+        # unknown (no decode plane on the replica, or not yet probed)
+        self.decode_free_slots = -1
+        self.decode_pages_free = -1
         self.successes = 0
         self.failures = 0
         self.hedges = 0              # hedge requests sent to this replica
@@ -253,6 +261,13 @@ class Membership:
             if ok:
                 replica.queue_depth = int(body.get("queue_depth", 0))
                 replica.reported_in_flight = int(body.get("in_flight", 0))
+                dec = body.get("decode")
+                if isinstance(dec, dict):
+                    replica.decode_free_slots = int(dec.get("free_slots", -1))
+                    replica.decode_pages_free = int(dec.get("pages_free", -1))
+                else:
+                    replica.decode_free_slots = -1
+                    replica.decode_pages_free = -1
         if ok:
             # a live /healthz is recovery evidence: without it an ejected
             # replica on an idle fleet stays OPEN forever, because half-open
@@ -269,16 +284,38 @@ class Membership:
 
     # -- dispatch bookkeeping ------------------------------------------------
 
-    def pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
+    def pick(self, exclude: Sequence[Replica] = (),
+             signal: str = "predict") -> Optional[Replica]:
         """Least-loaded live replica (healthy + breaker allows), or None.
         ``exclude`` skips replicas already tried for this request (reroute)
-        or already carrying its primary attempt (hedge)."""
+        or already carrying its primary attempt (hedge).
+
+        ``signal`` selects the load metric. ``"predict"`` (default) is the
+        classic least-loaded order: router-side in-flight, tie-broken by
+        replica queue depth. ``"generate"`` routes by **KV headroom**: a
+        decode replica's real capacity is free slots/pages, not queue depth
+        — a replica with a short queue but zero free pages would 503 every
+        admission. Page- or slot-starved replicas sort last (still
+        dispatchable as a last resort: replica-side admission turns it into
+        explicit backpressure), the rest order by router in-flight then most
+        pages free; replicas with unknown headroom (-1) sort after known
+        ones at equal in-flight."""
         skip = set(id(r) for r in exclude)
+
+        if signal == "generate":
+            def key(r):
+                starved = 1 if (r.decode_pages_free == 0
+                                or r.decode_free_slots == 0) else 0
+                return (starved, r.inflight, -r.decode_pages_free, r.index)
+        else:
+            def key(r):
+                return (r.inflight, r.queue_depth, r.index)
+
         with self._lock:
             ordered = sorted(
                 (r for r in self._replicas
                  if id(r) not in skip and r.healthy),
-                key=lambda r: (r.inflight, r.queue_depth, r.index))
+                key=key)
         # breaker.allow() outside the membership lock, in load order, and
         # ONLY until the first taker: allow() on a HALF_OPEN breaker claims
         # its single trial slot, so probing replicas we then don't dispatch
@@ -339,6 +376,8 @@ class Membership:
             rows = [dict(url=r.url, index=r.index, healthy=r.healthy,
                          inflight=r.inflight, queue_depth=r.queue_depth,
                          reported_in_flight=r.reported_in_flight,
+                         decode_free_slots=r.decode_free_slots,
+                         decode_pages_free=r.decode_pages_free,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
                     for r in self._replicas]
@@ -361,3 +400,5 @@ class Membership:
             self.metrics.gauge(f"{prefix}/error_rate",
                                row["failures"] / total if total else 0.0)
             self.metrics.gauge(f"{prefix}/hedges", float(row["hedges"]))
+            self.metrics.gauge(f"{prefix}/kv_pages_free",
+                               float(row["decode_pages_free"]))

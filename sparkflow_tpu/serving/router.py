@@ -423,7 +423,8 @@ class RouterServer:
             cond.wait_for(lambda: outcomes, timeout=self._hedge_delay_s())
             primary_done = bool(outcomes)
         if not primary_done:
-            second = self.membership.pick(exclude=[primary])
+            signal = "generate" if path == "/v1/generate" else "predict"
+            second = self.membership.pick(exclude=[primary], signal=signal)
             if second is not None:
                 self.metrics.incr("router/hedges")
                 launch(second, True)
@@ -480,15 +481,16 @@ class RouterServer:
         tried: List[Replica] = []
         last: Optional[Dict[str, Any]] = None
         budget = self.dispatch_retries + 1
+        signal = "generate" if path == "/v1/generate" else "predict"
         for attempt in range(budget):
             if attempt:
                 self.metrics.incr("router/rerouted")
-            replica = self.membership.pick(exclude=tried)
+            replica = self.membership.pick(exclude=tried, signal=signal)
             if replica is None and tried:
                 # every replica already tried this request — start a fresh
                 # pass; a restarted/half-open replica may be back
                 tried = []
-                replica = self.membership.pick()
+                replica = self.membership.pick(signal=signal)
             if replica is None:
                 self.metrics.incr("router/no_healthy_replica")
             else:

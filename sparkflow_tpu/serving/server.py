@@ -384,11 +384,19 @@ class InferenceServer:
                 "engine": stats}
         if self.generate_batcher is not None:
             gb = self.generate_batcher
+            gstats = (gb.engine.stats()
+                      if hasattr(gb.engine, "stats") else {})
+            kv = gstats.get("kv", {}) if isinstance(gstats, dict) else {}
             body["decode"] = {
                 "queue_depth": gb.depth(),
                 "in_flight": gb.inflight_rows(),
-                "engine": (gb.engine.stats()
-                           if hasattr(gb.engine, "stats") else {}),
+                # KV headroom: the router places /v1/generate traffic by
+                # these, not queue depth — a page-starved replica would 503
+                # new generations no matter how short its queue looks
+                "free_slots": (int(kv.get("num_slots", 0))
+                               - int(kv.get("slots_active", 0))),
+                "pages_free": int(kv.get("pages_free", 0)),
+                "engine": gstats,
             }
         if state in (ServerState.SERVING, ServerState.STARTING):
             return 200, body, None

@@ -256,6 +256,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
     # optimizer-state memory per device, same collective bytes. 'auto' turns
     # on when the optimizer carries per-param state and dp >= 2.
     weightUpdateSharding = Param(Params._dummy(), "weightUpdateSharding", "", typeConverter=TypeConverters.toString)
+    # upgrade: explicit ZeRO stage (0-3) mapped through as_sharding_config
+    # into the Trainer's declarative ShardingConfig; -1 (default) leaves the
+    # legacy weightUpdateSharding semantics in charge. Unlike 'auto', a set
+    # stage is a REQUEST — ineligible fits raise instead of falling back.
+    zeroStage = Param(Params._dummy(), "zeroStage", "", typeConverter=TypeConverters.toInt)
 
     @keyword_only
     def __init__(self,
@@ -290,7 +295,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  useEmaWeights=None,
                  ppMicrobatches=None,
                  ppSchedule=None,
-                 weightUpdateSharding=None):
+                 weightUpdateSharding=None,
+                 zeroStage=None):
         """Same parameter meanings as the reference estimator docstring
         (``tensorflow_async.py:146-175``); ``acquireLock`` and ``port`` are
         accepted no-ops under synchronous all-reduce training. ``weightsPath``,
@@ -309,7 +315,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                          fitMode='collect', extraInputCols=None,
                          extraTfInputs=None, meshShape=None,
                          useEmaWeights=False, ppMicrobatches=-1,
-                         ppSchedule='gpipe', weightUpdateSharding='auto')
+                         ppSchedule='gpipe', weightUpdateSharding='auto',
+                         zeroStage=-1)
         self._loss_callback = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -347,7 +354,8 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                  useEmaWeights=None,
                  ppMicrobatches=None,
                  ppSchedule=None,
-                 weightUpdateSharding=None):
+                 weightUpdateSharding=None,
+                 zeroStage=None):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
 
@@ -473,6 +481,11 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             raise ValueError(
                 "weightUpdateSharding must be 'auto', 'on', or 'off'; got %r"
                 % wus)
+        zs = _opt_param(self, self.zeroStage, -1)
+        zs = -1 if zs is None else int(zs)
+        if zs not in (-1, 0, 1, 2, 3):
+            raise ValueError(
+                "zeroStage must be -1 (unset) or 0-3; got %r" % zs)
         if self.getOrDefault(self.useEmaWeights):
             # fail BEFORE training, not after hours of fit: the EMA only
             # exists when the optimizer maintains it (build_optimizer
@@ -501,6 +514,18 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
                 "a port for (weights never leave the device mesh)",
                 self.getPort())
         return fit_mode, extra_cols, extra_inputs, mesh_axes
+
+    def _sharding_config(self):
+        """``zeroStage`` >= 0 mapped into a declarative
+        :class:`~sparkflow_tpu.sharding.ShardingConfig`; ``-1`` (unset)
+        returns ``None`` so the legacy ``weightUpdateSharding`` knob keeps
+        driving the trainer's eligibility gate."""
+        stage = _opt_param(self, self.zeroStage, -1)
+        stage = -1 if stage is None else int(stage)
+        if stage < 0:
+            return None
+        from .sharding import as_sharding_config
+        return as_sharding_config({"zero_stage": stage})
 
     def _fit(self, dataset):
         inp_col = self.getOrDefault(self.inputCol)
@@ -549,6 +574,7 @@ class SparkAsyncDL(Estimator, HasInputCol, HasPredictionCol, HasLabelCol,
             pp_schedule=_opt_param(self, self.ppSchedule, "gpipe") or "gpipe",
             weight_update_sharding=(_opt_param(self, self.weightUpdateSharding,
                                                "auto") or "auto"),
+            sharding=self._sharding_config(),
             # alongside the built optax object so the zero1 'auto' gate can
             # see clip_norm / ema_decay
             optimizer_options=(json.loads(optimizer_options)

@@ -98,6 +98,23 @@ def test_paged_attention_ignores_garbage_beyond_length():
     np.testing.assert_allclose(out1, out2, atol=1e-6)
 
 
+def test_paged_attention_aliased_pages_share_prefix():
+    """Two slots whose tables alias the same physical page (shared prefix)
+    must attend identically when their suffixes also match — the kernel is
+    oblivious to sharing, only the table differs."""
+    rs = np.random.RandomState(11)
+    h, d, page_size = 2, 8, 8
+    q1 = rs.randn(h, d).astype(np.float32)
+    q = np.stack([q1, q1])  # same query for both slots
+    k = rs.randn(4, page_size, h, d).astype(np.float32)
+    v = rs.randn(4, page_size, h, d).astype(np.float32)
+    k[3], v[3] = k[2], v[2]  # slot 1's private page duplicates slot 0's
+    table = np.asarray([[1, 2], [1, 3]], np.int32)  # page 1 aliased
+    lens = np.asarray([12, 12], np.int32)
+    out = np.asarray(paged_attention(q, k, v, table, lens, interpret=True))
+    np.testing.assert_allclose(out[0], out[1], atol=1e-6)
+
+
 # -- page pool ---------------------------------------------------------------
 
 
@@ -180,6 +197,113 @@ def test_kvcache_rejects_oversized_and_bad_slots():
         kv.alloc(0, 1, 4)  # already active
     with pytest.raises(ValueError):
         kv.append(1)  # not active
+
+
+# -- shared-prefix COW --------------------------------------------------------
+
+
+def test_kvcache_prefix_sharing_cow_invariants():
+    """Refcounted page sharing: aliased tables on a prefix hit, refcounts
+    never negative, shared pages survive one slot's release, divergence
+    mid-block allocates a private page (COW without the copy)."""
+    kv = PagedKVCache(num_pages=17, page_size=4, num_slots=4,
+                      max_pages_per_slot=4)
+    sys9 = [7, 7, 7, 7, 1, 2, 3, 4, 9]
+    # cold prompt: nothing indexed yet, everything allocated privately
+    assert kv.alloc(0, sys9, 12) == (0, 0)
+    assert kv.commit_prefix(0, sys9) == 2  # two full blocks published
+    # second slot with the same two leading blocks shares both pages
+    shared, saved = kv.alloc(1, [7, 7, 7, 7, 1, 2, 3, 4, 5], 12)
+    assert (shared, saved) == (2, 8)
+    t = kv.page_tables()
+    assert (t[0, :2] == t[1, :2]).all()   # aliased prefix pages
+    assert t[0, 2] != t[1, 2]             # divergent tail page is private
+    rc = kv.refcounts()
+    assert rc[t[0, 0]] == 2 and rc[t[0, 1]] == 2
+    assert rc[t[0, 2]] == 1 and rc[t[1, 2]] == 1
+    # releasing one owner decrements, never frees a still-shared page
+    kv.free(0)
+    rc = kv.refcounts()
+    assert (rc >= 0).all()
+    assert rc[t[1, 0]] == 1 and rc[t[1, 1]] == 1
+    assert kv.stats()["pages_used"] == 3
+    # releasing the last owner retires everything; indexed pages park in the
+    # cached tier but stay reclaimable, so pages_free sees the whole pool
+    kv.free(1)
+    st = kv.stats()
+    assert st["pages_used"] == 0 and st["pages_free"] == 16
+    assert st["pages_cached"] == 2
+    assert (kv.refcounts() == 0).all()
+    # revival + mid-block divergence: first block hits (revived from the
+    # cached tier), second block differs inside the page -> private page
+    shared, saved = kv.alloc(2, [7, 7, 7, 7, 1, 2, 99, 100, 3], 12)
+    assert (shared, saved) == (1, 4)
+    t = kv.page_tables()
+    assert kv.refcounts()[t[2, 0]] == 1
+    assert kv.stats()["prefix_hits"] >= 2
+    kv.free(2)
+    assert kv.stats()["pages_used"] == 0
+
+
+def test_kvcache_admission_exact_with_sharing():
+    """can_admit/alloc account for shared pages exactly: a request that
+    doesn't fit cold fits once its prefix pages are shared, and the pages it
+    does NOT consume stay admittable — never double-reserved."""
+    kv = PagedKVCache(num_pages=9, page_size=4, num_slots=3,
+                      max_pages_per_slot=8)
+    base = list(range(8))
+    kv.alloc(0, base, 8)  # 2 pages, no reservation
+    kv.commit_prefix(0, base)
+    # 28 tokens = 7 pages > 6 free, cold -> refuse; with 2 shared -> admit
+    assert not kv.can_admit(28)
+    assert kv.can_admit(28, base + [1, 2])
+    shared, saved = kv.alloc(1, base + [1, 2], 28)
+    assert (shared, saved) == (2, 8)
+    st = kv.stats()
+    # slot 1 holds 3 pages (2 shared + 1 private) and reserves 4 more for
+    # growth to 28 tokens; exactly one un-reserved page remains
+    assert st["pages_reserved"] == 4
+    assert kv.can_admit(4)
+    assert not kv.can_admit(8)
+    kv.free(1)
+    kv.free(0)
+    assert kv.stats()["pages_reserved"] == 0
+
+
+def test_kvcache_no_leak_under_prefix_churn():
+    """200 iterations of random alloc/commit/append/free with prefix reuse:
+    refcounts never go negative and the pool drains back to empty."""
+    kv = PagedKVCache(num_pages=33, page_size=4, num_slots=4,
+                      max_pages_per_slot=8)
+    rs = np.random.RandomState(1)
+    prefixes = [list(rs.randint(1, 50, size=8)) for _ in range(3)]
+    live = {}
+    for _ in range(200):
+        slot = kv.free_slot()
+        if slot is not None and rs.rand() < 0.6:
+            pref = prefixes[rs.randint(len(prefixes))]
+            prompt = pref + list(rs.randint(1, 50, size=rs.randint(1, 9)))
+            total = len(prompt) + int(rs.randint(1, 8))
+            if kv.can_admit(total, prompt):
+                kv.alloc(slot, prompt, total)
+                kv.commit_prefix(slot, prompt)
+                live[slot] = (len(prompt), total)
+        for s in list(live):
+            ln, total = live[s]
+            if ln < total and rs.rand() < 0.7:
+                kv.append(s)
+                live[s] = (ln + 1, total)
+            elif rs.rand() < 0.3:
+                kv.free(s)
+                del live[s]
+        assert (kv.refcounts() >= 0).all()
+    for s in list(live):
+        kv.free(s)
+    st = kv.stats()
+    assert st["pages_used"] == 0 and st["pages_reserved"] == 0
+    assert st["pages_free"] == 32 and st["tokens"] == 0
+    assert (kv.refcounts() == 0).all()
+    assert st["prefix_hits"] > 0  # the churn actually exercised sharing
 
 
 # -- decode engine ------------------------------------------------------------
@@ -271,6 +395,109 @@ def test_engine_admission_bounds(engine):
     assert engine.can_admit(2, 4)
     assert not engine.can_admit(engine.max_prompt_len + 1, 1)
     assert not engine.can_admit(2, engine.max_seq_len)
+
+
+# -- prefix sharing + chunked prefill on the engine ---------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_chunked(lm):
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8)
+
+
+def _engine_greedy(eng, prompt, n):
+    """Drive one request to n greedy tokens, riding out a chunked prefill
+    (token=None) if the engine split the prompt. Returns (tokens, info)."""
+    info = eng.prefill(prompt, max_new_tokens=n, temperature=0.0)
+    toks = [] if info["token"] is None else [info["token"]]
+    while len(toks) < n:
+        out = eng.step()
+        if info["slot"] in out:
+            toks.append(out[info["slot"]])
+    eng.release(info["slot"])
+    return toks, info
+
+
+def test_engine_prefix_sharing_greedy_parity(lm):
+    """Greedy decode is bit-identical with sharing on vs off, across cold
+    prompts, prefix hits, and mid-page divergence; the prefix-hit pass skips
+    exactly the shared pages."""
+    model, params = lm
+    eng_on = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0)
+    eng_off = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                           prefix_cache=False)
+    sys_p = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13]
+    prompts = [sys_p + [17, 18],                 # publishes the sys blocks
+               sys_p + [17, 19],                 # prefix hit, new tail
+               sys_p[:6] + [40, 41, 42, 43],     # diverges mid-block: cold
+               [33, 21]]                         # unrelated short prompt
+    for p in prompts:
+        ref = _dense_greedy(model, params, p, 5)
+        t_on, _ = _engine_greedy(eng_on, p, 5)
+        t_off, _ = _engine_greedy(eng_off, p, 5)
+        assert t_on == ref and t_off == ref, f"divergence on {p}"
+    # replay the first prompt: its system prefix is indexed now, so the
+    # prefill skips one full page and still lands on identical tokens
+    t_on, info = _engine_greedy(eng_on, sys_p + [17, 18], 5)
+    assert info["shared_tokens"] == 8
+    assert t_on == _dense_greedy(model, params, sys_p + [17, 18], 5)
+    assert eng_on.kv.stats()["prefix_hits"] >= 1
+    assert eng_off.kv.stats()["prefix_hits"] == 0
+    assert eng_on.stats()["steady_traces"] == 0
+    assert eng_off.stats()["steady_traces"] == 0
+
+
+def test_chunked_prefill_keeps_decode_cadence(engine_chunked, lm):
+    """A long prompt arriving mid-stream prefills one chunk per step fused
+    with the decode batch: the in-flight request produces a token on EVERY
+    step, and the newcomer's first token lands after ceil(n/chunk) steps."""
+    model, params = lm
+    eng = engine_chunked
+    a = eng.prefill([1, 2, 3], max_new_tokens=20, temperature=0.0)
+    b = eng.prefill(list(range(1, 25)), max_new_tokens=4, temperature=0.0)
+    assert b["token"] is None and b["chunked"]
+    toks_a, toks_b, first_b = [a["token"]], [], None
+    for i in range(19):
+        out = eng.step()
+        assert a["slot"] in out, f"decode cadence broken at step {i}"
+        toks_a.append(out[a["slot"]])
+        if b["slot"] in out and len(toks_b) < 4:
+            first_b = i if first_b is None else first_b
+            toks_b.append(out[b["slot"]])
+            if len(toks_b) == 4:
+                eng.release(b["slot"])
+    eng.release(a["slot"])
+    assert first_b == 2  # 24 prompt tokens / chunk 8 -> 3 fused steps
+    assert toks_a == _dense_greedy(model, params, [1, 2, 3], 20)
+    assert toks_b == _dense_greedy(model, params, list(range(1, 25)), 4)
+    assert eng.stats()["steady_traces"] == 0
+    assert eng.stats()["pending_prefills"] == 0
+
+
+def test_continuous_batching_shared_prefix_parity(engine_chunked, lm):
+    """Batcher over a chunked, prefix-sharing engine: chunked-cold, shared
+    sync-suffix, and ladder admissions interleave and every request stays
+    greedy-exact against the dense forward."""
+    model, params = lm
+    cb = ContinuousBatcher(engine_chunked, max_queue=32)
+    try:
+        sysp = [11, 3, 5, 8, 2, 9, 4, 6]
+        prompts = ([sysp + [i] for i in (1, 2, 3)] + [[5, 2]]
+                   + [sysp + [4, i] for i in (7, 9)])
+        budgets = [4, 6, 3, 5, 4, 6]
+        futs = [cb.submit(p, max_new_tokens=n, temperature=0.0)
+                for p, n in zip(prompts, budgets)]
+        for p, n, f in zip(prompts, budgets, futs):
+            r = f.result(timeout=120)
+            assert r["tokens"] == _dense_greedy(model, params, p, n)
+            assert r["num_tokens"] == n
+        assert engine_chunked.stats()["steady_traces"] == 0
+        assert engine_chunked.kv.stats()["prefix_hits"] >= 1
+        assert engine_chunked.kv.stats()["slots_active"] == 0
+    finally:
+        cb.close()
 
 
 # -- continuous batching ------------------------------------------------------

@@ -276,6 +276,26 @@ def test_prometheus_text_is_parseable():
     assert "latency_ms_sum 4950" in text
 
 
+def test_decode_gauges_prometheus_exposition():
+    """The decode plane's KV gauges (occupancy, fragmentation, prefix hit
+    rate, tokens saved) land in the Prometheus text with sanitized names."""
+    from sparkflow_tpu.serving import PagedKVCache
+    m = Metrics()
+    kv = PagedKVCache(num_pages=9, page_size=4, num_slots=2,
+                      max_pages_per_slot=4, metrics=m)
+    kv.alloc(0, list(range(8)), 10)
+    kv.commit_prefix(0, list(range(8)))
+    kv.alloc(1, list(range(8)), 10)  # prefix hit: 1 of 2 lookups
+    text = prometheus_text(m)
+    for fam in ("decode_occupancy", "decode_fragmentation",
+                "decode_prefix_hit_rate", "decode_tokens_saved"):
+        assert f"# TYPE {fam} gauge" in text, fam
+    assert "decode_prefix_hit_rate 0.5" in text
+    # one block shared (the final prompt token is always recomputed, so an
+    # exactly-two-page prompt shares only its first block): 4 tokens saved
+    assert "decode_tokens_saved 4" in text
+
+
 # -- memory watcher ----------------------------------------------------------
 
 def test_memory_watcher_sample_publishes_gauges():

@@ -201,6 +201,80 @@ def test_membership_snapshot_and_gauges():
     m.stop()
 
 
+def test_generate_pick_prefers_kv_headroom():
+    """Generate dispatch ranks replicas by decode KV headroom, not queue
+    depth: page-/slot-starved replicas sort last (still dispatchable — the
+    replica's own 503 is the real backpressure), unknown headroom after any
+    known-positive one. Predict picks are untouched."""
+    m = Membership(["http://127.0.0.1:1", "http://127.0.0.1:2",
+                    "http://127.0.0.1:3"], probe_interval_s=60.0)
+    ra, rb, rc = m.replicas
+    ra.decode_pages_free, ra.decode_free_slots = 0, 2    # page-starved
+    rb.decode_pages_free, rb.decode_free_slots = 10, 1
+    rc.decode_pages_free, rc.decode_free_slots = 40, 3
+    assert m.pick(signal="generate") is rc               # most headroom
+    assert m.pick(exclude=[rc], signal="generate") is rb
+    assert m.pick(exclude=[rb, rc], signal="generate") is ra  # last resort
+    rc.queue_depth = 50
+    assert m.pick(signal="generate") is rc  # queue depth is not the signal
+    assert m.pick(signal="predict") is ra   # predict ranking unchanged
+    rb.decode_pages_free = -1               # unknown sorts after known
+    assert m.pick(exclude=[rc], signal="generate") is rb  # but before starved
+    m.stop()
+
+
+def test_page_starved_replica_keeps_predict_loses_generate(make_engine):
+    """End to end: a replica whose decode pool is exhausted stops receiving
+    /v1/generate traffic from the router but keeps serving /v1/predict."""
+    import jax
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    from sparkflow_tpu.serving import ContinuousBatcher, DecodeEngine
+    spec = build_registry_spec("transformer_lm", vocab_size=61, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=32, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    engines = [DecodeEngine(model, params, num_slots=2, page_size=8, seed=0)
+               for _ in range(2)]
+    cbs = [ContinuousBatcher(e, max_queue=8) for e in engines]
+    servers = [InferenceServer(make_engine(), generate_batcher=cb,
+                               max_delay_ms=1.0).start() for cb in cbs]
+    # starve replica 0's decode plane before the router ever probes it:
+    # every slot (and its page reservation) is occupied, nothing decodes
+    for slot in range(2):
+        engines[0].kv.alloc(slot, 1, engines[0].max_seq_len)
+    pre = [e.stats()["prefills"] for e in engines]
+    router = RouterServer([s.url for s in servers], probe_interval_s=0.05,
+                          dispatch_retries=2).start()
+    try:
+        m = router.membership
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if (m.replicas[0].decode_free_slots == 0
+                    and m.replicas[1].decode_free_slots > 0):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("probes never harvested the decode headroom")
+        assert m.pick(signal="generate") is m.replicas[1]
+        assert m.pick(signal="predict") is m.replicas[0]
+        cli = ServingClient(router.url, timeout=60)
+        for _ in range(3):
+            r = cli.generate([3, 1, 4], max_new_tokens=4)
+            assert r["num_tokens"] == 4
+        assert engines[0].stats()["prefills"] == pre[0]  # starved: bypassed
+        assert engines[1].stats()["prefills"] == pre[1] + 3
+        out = cli.predict(np.zeros((2, 4), np.float32))  # predict still up
+        assert out.shape == (2, 2)
+    finally:
+        router.stop()
+        for cb in cbs:
+            cb.close()
+        for s in servers:
+            s.stop()
+
+
 # -- replica /healthz load signal (satellite) --------------------------------
 
 def test_replica_healthz_reports_queue_depth_and_in_flight(make_engine):

@@ -285,3 +285,25 @@ def test_estimator_weight_update_sharding_param():
     est.setParams(weightUpdateSharding="banana")
     with pytest.raises(ValueError, match="weightUpdateSharding"):
         est._validate_params()
+
+
+def test_estimator_zero_stage_param():
+    """zeroStage plumbing: default -1 (unset) leaves sharding=None so the
+    legacy weightUpdateSharding knob stays in charge; a set stage maps
+    through as_sharding_config into an explicit ShardingConfig request; an
+    out-of-range stage fails validation before any training."""
+    from sparkflow_tpu.spark_async import SparkAsyncDL
+    est = SparkAsyncDL(inputCol="features", tensorflowGraph=mlp(10, 3),
+                       tfInput="x:0", tfLabel="y:0", tfOutput="out:0",
+                       labelCol="labels")
+    assert est.getOrDefault(est.zeroStage) == -1
+    assert est._sharding_config() is None
+    est.setParams(zeroStage=2)
+    est._validate_params()
+    cfg = est._sharding_config()
+    assert cfg is not None and cfg.zero_stage == 2
+    est.setParams(zeroStage=3)
+    assert est._sharding_config().zero_stage == 3
+    est.setParams(zeroStage=7)
+    with pytest.raises(ValueError, match="zeroStage"):
+        est._validate_params()
