@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke zero-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke zero-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -81,6 +81,15 @@ fleet-smoke:
 decode-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/decode_smoke.py
+
+# speculative-decode smoke: the decode test suite, then a real server
+# subprocess with speculation on — a mixed-length greedy burst must be
+# token-identical to spec-off decode, zero steady-state retraces, clean
+# SIGTERM drain; finishes with the spec-on/off benchmark (docs/serving.md)
+spec-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/spec_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --spec-decode
 
 # chaos suite: deterministic fault injection against checkpoints, resume,
 # coordinator joins, and serving drain (docs/resilience.md)

@@ -358,7 +358,8 @@ class TransformerLM(_TransformerBase):
                  "v": cache["v"].at[layer].set(v)}
         return out.astype(q.dtype), cache
 
-    def decode_step(self, params, cache, token, pos, attend=None):
+    def decode_step(self, params, cache, token, pos, attend=None,
+                    num_layers: Optional[int] = None):
         """Single-token autoregressive apply: embed ``token`` [B] int32 at
         position ``pos`` [B] int32, run every block over the cached history,
         return ``(logits [B, vocab] f32, cache)``.
@@ -366,21 +367,52 @@ class TransformerLM(_TransformerBase):
         ``attend(layer, q, k_new, v_new, cache, pos) -> (att [B,heads,d],
         cache)`` owns the KV cache layout; the default uses the dense cache
         from :meth:`init_decode_cache`, the serving engine passes a paged
-        closure over :func:`~sparkflow_tpu.ops.paged_attention`."""
+        closure over :func:`~sparkflow_tpu.ops.paged_attention`.
+
+        ``num_layers`` truncates the stack to its first N blocks (then the
+        usual final LN + tied-embedding head) — the self-speculation draft:
+        the truncated model's layer-i K/V is *identical* to the full model's,
+        so a draft pass can read and write the same paged pool the verify
+        pass uses, no separate draft cache or prefill needed."""
         if attend is None:
             attend = self._dense_cache_attend
+        L = self.num_layers if num_layers is None else int(num_layers)
         token = token.astype(jnp.int32)
         pos = pos.astype(jnp.int32)
         x = jnp.take(params["embed"]["tok"], token, axis=0)
         posemb = jnp.take(params["embed"]["pos"],
                           jnp.clip(pos, 0, self.max_len - 1), axis=0)
         x = self.cast(x + posemb)[:, None, :]              # [B, 1, hidden]
-        for i in range(self.num_layers):
+        for i in range(L):
             x, cache = self._block_decode(params[f"block_{i}"], x, i, cache,
                                           pos, attend)
         x = _layer_norm(x, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
         logits = jnp.matmul(x[:, 0].astype(jnp.float32),
+                            params["embed"]["tok"].T.astype(jnp.float32))
+        return logits, cache
+
+    def decode_verify(self, params, ids, start, cache, attend):
+        """Speculative-verify forward: like :meth:`prefill_suffix` (``ids``
+        [B,S] starting at absolute position ``start`` [B], attention over
+        committed history + this chunk delegated to ``attend``) but projects
+        logits at **every** position — ``(logits [B, S, vocab] f32, cache)``
+        — so one call scores a drafted token block: ``logits[:, j]`` is the
+        target model's next-token distribution after prefix + drafts[:j]."""
+        ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        start = start.astype(jnp.int32)
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        posemb = jnp.take(params["embed"]["pos"],
+                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
+        x = self.cast(x + posemb)
+        for i in range(self.num_layers):
+            x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
+                                          start, attend)
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        logits = jnp.matmul(x.astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
         return logits, cache
 

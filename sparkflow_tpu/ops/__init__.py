@@ -16,8 +16,11 @@ scheduling are hand-written pallas kernels with jnp fallbacks for CPU tests:
 
 from .attention import (attention_reference, flash_attention,
                         paged_attention, paged_attention_reference,
+                        paged_attention_verify,
+                        paged_attention_verify_reference,
                         ring_attention, ring_flash_attention)
 
 __all__ = ["flash_attention", "ring_attention", "ring_flash_attention",
            "attention_reference", "paged_attention",
-           "paged_attention_reference"]
+           "paged_attention_reference", "paged_attention_verify",
+           "paged_attention_verify_reference"]

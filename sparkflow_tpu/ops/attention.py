@@ -824,6 +824,158 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths,
 
 
 # ---------------------------------------------------------------------------
+# Paged verify attention (multi-query-position decode for speculative steps)
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_verify_reference(q, k_pages, v_pages, page_table, start,
+                                     sm_scale: Optional[float] = None):
+    """Ground-truth multi-position decode attention over a paged KV pool.
+
+    The speculative verify step scores ``S = k + 1`` consecutive positions
+    per slot in one call: slot b's query ``s`` sits at absolute position
+    ``start[b] + s`` and attends causally over everything at or before it.
+
+    - ``q``: ``[B, H, S, D]`` — S consecutive query tokens per slot;
+    - ``k_pages`` / ``v_pages``: ``[num_pages, page_size, H, D]`` pool, with
+      the K/V for all S positions already written (the engine's attend
+      scatters them before calling);
+    - ``page_table``: ``[B, max_pages]`` int32, scratch-padded like
+      :func:`paged_attention_reference`;
+    - ``start``: ``[B]`` int32 — tokens committed *before* this chunk; query
+      ``s`` attends positions ``<= start[b] + s``, so ``S == 1`` degenerates
+      to :func:`paged_attention_reference` with ``lengths = start + 1``.
+
+    Every query attends at least itself, so there is no empty-slot case.
+    """
+    b, h, s, d = q.shape
+    page = k_pages.shape[1]
+    maxp = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    k = k_pages[page_table].reshape(b, maxp * page, h, d)
+    v = v_pages[page_table].reshape(b, maxp * page, h, d)
+    att = jnp.einsum("bhsd,bkhd->bhsk", q.astype(jnp.float32),
+                     k.astype(jnp.float32),
+                     preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(maxp * page, dtype=jnp.int32)
+    qpos = start[:, None] + jnp.arange(s, dtype=jnp.int32)       # [B, S]
+    valid = pos[None, None, :] <= qpos[:, :, None]               # [B, S, K]
+    att = jnp.where(valid[:, None, :, :], att, NEG_INF)
+    p = jax.nn.softmax(att, axis=-1)
+    out = jnp.einsum("bhsk,bkhd->bhsd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_verify_kernel(table_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, page_size: int,
+                         num_q: int, sm_scale: float):
+    """Grid ``(B, max_pages)`` exactly like :func:`_paged_kernel`, but the
+    online-softmax state carries ``num_q`` query rows per head and the
+    validity mask is per-query causal (``tpos <= start[b] + s``)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    np_ = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+
+    @pl.when(p * page_size < start + num_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [H, S, D]
+        k = k_ref[0].astype(jnp.float32)                  # [page, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        # att[h, s, t] = q[h, s, :] . k[t, h, :] (batch H, contract D)
+        att = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (1,))),
+                                  preferred_element_type=jnp.float32
+                                  ) * sm_scale             # [H, S, page]
+        tpos = p * page_size + jax.lax.broadcasted_iota(jnp.int32,
+                                                        att.shape, 2)
+        qpos = start + jax.lax.broadcasted_iota(jnp.int32, att.shape, 1)
+        att = jnp.where(tpos <= qpos, att, NEG_INF)
+        m_prev = m_ref[:]                                 # [H, S, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(att, axis=2, keepdims=True))
+        pexp = jnp.exp(att - m_new)                       # [H, S, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(pexp, axis=2, keepdims=True)
+        # acc[h, s, d] += sum_t pexp[h, s, t] * v[t, h, d]
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            pexp, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(p == np_ - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention_verify(q, k_pages, v_pages, page_table, start,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Speculative-verify attention kernel: ``S`` consecutive query positions
+    per slot against the page-table-indirected K/V pool, per-query causal.
+    Same operands/semantics as :func:`paged_attention_verify_reference`
+    (its parity ground truth); same scalar-prefetch page-gather structure as
+    :func:`paged_attention` — the grid just carries S query rows of
+    online-softmax state instead of one. Pages wholly past ``start[b] + S``
+    cost no flops. Falls back to the reference (reported via
+    ``last_attention_path``) when the tile rules are violated.
+    """
+    b, h, s, d = q.shape
+    page = k_pages.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+    # compiled q/acc blocks are [H, S, D]: sublane dim S % 8, lane D % 128;
+    # k/v blocks [page, H, D] need H % 8 like the single-query kernel
+    tiles_ok = (pltpu is not None
+                and (interpret or (h % 8 == 0 and d % 128 == 0
+                                   and s % 8 == 0)))
+    if not tiles_ok:
+        _LAST_PATH.set("reference")
+        return paged_attention_verify_reference(q, k_pages, v_pages,
+                                                page_table, start,
+                                                sm_scale=scale)
+    _LAST_PATH.set("pallas")
+    maxp = page_table.shape[1]
+    kernel = functools.partial(_paged_verify_kernel, page_size=page,
+                               num_q=s, sm_scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxp),
+        in_specs=[
+            pl.BlockSpec((1, h, s, d), lambda bb, p, t, st: (bb, 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda bb, p, t, st: (t[bb, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda bb, p, t, st: (t[bb, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, s, d),
+                               lambda bb, p, t, st: (bb, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, s, d), jnp.float32),   # acc
+            pltpu.VMEM((h, s, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, s, 1), jnp.float32),   # running sum
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), start.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 

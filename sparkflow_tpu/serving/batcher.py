@@ -334,7 +334,7 @@ class MicroBatcher:
 class _GenPending:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "eos_id", "seed", "future", "enqueued_at", "request_id",
-                 "parent", "prefill_done_at", "slot", "tokens")
+                 "parent", "admitted_at", "prefill_done_at", "slot", "tokens")
 
     def __init__(self, prompt, max_new_tokens, temperature, top_k, eos_id,
                  seed, future, enqueued_at, request_id=None, parent=None):
@@ -348,6 +348,7 @@ class _GenPending:
         self.enqueued_at = enqueued_at
         self.request_id = request_id
         self.parent = parent
+        self.admitted_at = None
         self.prefill_done_at = None
         self.slot = None
         self.tokens: List[int] = []
@@ -537,6 +538,7 @@ class ContinuousBatcher:
                                      prompt=req.prompt):
             return None
         self._pending.pop(0)
+        req.admitted_at = time.perf_counter()
         self._prefilling += 1
         return req
 
@@ -581,11 +583,16 @@ class ContinuousBatcher:
     def _finish(self, req: _GenPending, reason: str) -> None:
         self.engine.release(req.slot)
         now = time.perf_counter()
-        queue_wait_ms = 0.0
+        # decomposition: enqueued -> admitted (queue wait) -> first token
+        # (prefill/TTFT) -> finish (decode). Each leg measures only its own
+        # span, whatever mix of chunked prefill and multi-token speculative
+        # bursts produced the tokens.
+        admitted = req.admitted_at or req.enqueued_at
+        queue_wait_ms = (admitted - req.enqueued_at) * 1000.0
         prefill_ms = 0.0
         if req.prefill_done_at is not None:
-            prefill_ms = (req.prefill_done_at - req.enqueued_at) * 1000.0
-        decode_ms = (now - (req.prefill_done_at or req.enqueued_at)) * 1000.0
+            prefill_ms = (req.prefill_done_at - admitted) * 1000.0
+        decode_ms = (now - (req.prefill_done_at or admitted)) * 1000.0
         total_ms = (now - req.enqueued_at) * 1000.0
         ntok = len(req.tokens)
         self.metrics.observe("serving/decode/request_latency_ms", total_ms)
@@ -615,20 +622,27 @@ class ContinuousBatcher:
         produced = self.engine.step()
         finished = []
         with self._cond:
-            for slot, tok in produced.items():
+            for slot, burst in produced.items():
                 req = self._active.get(slot)
                 if req is None:
                     continue
                 if req.prefill_done_at is None:
                     # chunked request's first token: TTFT stamps here
                     req.prefill_done_at = time.perf_counter()
-                req.tokens.append(tok)
-                if (req.eos_id is not None and tok == req.eos_id):
-                    finished.append((req, "eos"))
-                    del self._active[slot]
-                elif len(req.tokens) >= req.max_new_tokens:
-                    finished.append((req, "length"))
-                    del self._active[slot]
+                # a speculative step can commit 0..k+1 tokens per slot:
+                # consume the burst in order and retire mid-burst on eos or
+                # budget, discarding the remainder (the engine's extra KV
+                # past the retired length dies with release())
+                for tok in burst:
+                    req.tokens.append(tok)
+                    if req.eos_id is not None and tok == req.eos_id:
+                        finished.append((req, "eos"))
+                        del self._active[slot]
+                        break
+                    if len(req.tokens) >= req.max_new_tokens:
+                        finished.append((req, "length"))
+                        del self._active[slot]
+                        break
             if finished:
                 self._cond.notify_all()  # wait_drained watches _active
         for req, reason in finished:

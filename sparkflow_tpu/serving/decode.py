@@ -53,6 +53,25 @@ Two prefill-cost optimizations ride on the paged indirection:
   fixed-shape step cannot touch half-committed pages; its first token is
   sampled at the final chunk and surfaces through :meth:`step`'s result.
 
+**Speculative decoding** (``spec_k=N``) turns the one-token step into a
+multi-token one: a cheap draft proposes ``k`` tokens per slot (either
+*self-speculation* — the first ``draft_layers`` blocks of the same model
+running over the same paged pool, whose layer-i K/V is identical to the
+target's — or a separately supplied small ``draft_model`` with its own dense
+cache), then ONE fixed-shape verify call
+(:meth:`~sparkflow_tpu.models.transformer.TransformerLM.decode_verify` over
+:func:`~sparkflow_tpu.ops.paged_attention_verify`) scores all ``k + 1``
+positions for every live slot. The longest draft prefix matching the
+target's greedy argmax commits — plus the target's own "bonus" token at the
+first mismatch — and the rejected suffix rolls back through
+:meth:`PagedKVCache.truncate`, which reuses the refcount/free/COW machinery
+(a rollback that reaches into a shared page un-aliases it, never writes it).
+Greedy output is token-identical to non-speculative decode by construction;
+temperature slots simply run with a zero-width window (their bonus token is
+sampled from the verify logits with the same per-slot key cadence as the
+plain step). Draft + verify + rollback-copy are a bounded set of extra AOT
+shapes, so the zero-steady-state-retrace invariant holds unchanged.
+
 The engine is mechanism only — slot admission at token boundaries, queueing,
 futures and drain semantics live in
 :class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher`.
@@ -71,7 +90,7 @@ import numpy as np
 
 from ..analysis.runtime_guards import RecompileGuard
 from ..obs.spans import span as obs_span
-from ..ops import paged_attention
+from ..ops import paged_attention, paged_attention_verify
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
 from .kvcache import OutOfPages, PagedKVCache
@@ -123,6 +142,19 @@ class DecodeEngine:
         Enable shared-prefix KV caching (on by default): prompts share
         page-aligned prefix K/V through the pool's refcounted prefix index
         and only prefill their un-shared suffix.
+    spec_k : int
+        Speculative window: draft up to ``spec_k`` tokens per slot per step
+        and verify them (plus a bonus token) in one target call. 0 (default)
+        disables speculation — :meth:`step` still returns token *lists*, of
+        length 1.
+    draft_layers : int | None
+        Self-speculation depth: the draft is the target's first
+        ``draft_layers`` blocks over the same paged pool. Default (with
+        ``spec_k > 0`` and no ``draft_model``) is ``num_layers // 2``.
+    draft_model, draft_params
+        A separately trained small causal LM (same vocab) used as the draft
+        instead of self-speculation; it keeps its own dense KV cache and
+        prefills at admission through its own AOT ladder.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -131,6 +163,8 @@ class DecodeEngine:
                  seed: int = 0, warmup: bool = True,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
+                 spec_k: int = 0, draft_layers: Optional[int] = None,
+                 draft_model=None, draft_params=None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -174,6 +208,44 @@ class DecodeEngine:
         # chunking, else one page (prefix-hit suffixes are typically short)
         self._chunk_width = self.prefill_chunk or self.page_size
 
+        # speculative decoding configuration
+        self.spec_k = int(spec_k or 0)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.draft_layers: Optional[int] = None
+        self._draft_model = None
+        self._draft_params = None
+        if self.spec_k:
+            if draft_model is not None:
+                if isinstance(draft_model, str):
+                    from ..models import model_from_json
+                    draft_model = model_from_json(draft_model)
+                for need in ("prefill", "decode_step"):
+                    if not hasattr(draft_model, need):
+                        raise TypeError(f"draft_model has no {need}(); it "
+                                        f"must be a causal LM")
+                if int(draft_model.vocab_size) != int(model.vocab_size):
+                    raise ValueError(
+                        f"draft vocab_size={draft_model.vocab_size} != "
+                        f"target vocab_size={model.vocab_size}")
+                if draft_params is None:
+                    raise ValueError("draft_model requires draft_params")
+                if isinstance(draft_params, (list, tuple)):
+                    from ..graphdef import list_to_params
+                    draft_params = list_to_params(draft_model,
+                                                  list(draft_params))
+                self._draft_model = draft_model
+                self._draft_params = draft_params
+            else:
+                L = (int(draft_layers) if draft_layers
+                     else max(1, int(model.num_layers) // 2))
+                if not 1 <= L <= int(model.num_layers):
+                    raise ValueError(
+                        f"draft_layers={L} outside [1, {model.num_layers}]")
+                self.draft_layers = L
+        elif draft_model is not None or draft_layers:
+            raise ValueError("draft_model / draft_layers require spec_k >= 1")
+
         if isinstance(params, (list, tuple)):
             from ..graphdef import list_to_params
             params = list_to_params(model, list(params))
@@ -184,6 +256,19 @@ class DecodeEngine:
                       model.num_heads, model.head_dim)
         self._k_pool = jnp.zeros(pool_shape, pool_dtype)
         self._v_pool = jnp.zeros(pool_shape, pool_dtype)
+        if self._draft_model is not None:
+            dm = self._draft_model
+            # dense per-slot draft cache: positions can reach
+            # max_seq_len - 1 + spec_k during a clamped-window chain, and
+            # the final row is a write margin masked lanes are redirected
+            # to (it is never attended — live queries stop one short of it)
+            self._draft_cache_len = self.max_seq_len + self.spec_k + 1
+            dshape = (dm.num_layers, self.num_slots, dm.num_heads,
+                      self._draft_cache_len, dm.head_dim)
+            ddt = (dm.compute_dtype if dm.compute_dtype is not None
+                   else jnp.float32)
+            self._draft_k = jnp.zeros(dshape, ddt)
+            self._draft_v = jnp.zeros(dshape, ddt)
         self._keys = jnp.stack([jax.random.PRNGKey(seed + i)
                                 for i in range(self.num_slots)])
         self._last_token = np.zeros(self.num_slots, np.int32)
@@ -196,20 +281,36 @@ class DecodeEngine:
 
         self._lock = threading.Lock()
         # expected traces: one per prefill bucket + decode + prefill sampler
-        # + suffix prefill (+ the fused chunk/decode step when chunking)
+        # + suffix prefill (+ the fused chunk/decode step when chunking);
+        # speculation adds draft + verify + rollback page-copy, and an
+        # external draft its own prefill ladder — all compiled in warmup
+        spec_shapes = 0
+        if self.spec_k:
+            spec_shapes = 3 + (len(self.prefill_buckets)
+                               if self._draft_model is not None else 0)
         self.recompile_guard = RecompileGuard(
             name="serving.decode",
             warn_after=len(self.prefill_buckets) + 3
-            + (1 if self.prefill_chunk else 0))
+            + (1 if self.prefill_chunk else 0) + spec_shapes)
         self._prefill_exes: Dict[int, Any] = {}
         self._decode_exe: Any = None
         self._sample_exe: Any = None
         self._suffix_exe: Any = None
         self._fused_exe: Any = None
+        self._draft_exe: Any = None
+        self._verify_exe: Any = None
+        self._copy_exe: Any = None
+        self._draft_prefill_exes: Dict[int, Any] = {}
         self.aot_compiles = 0
         self._steps = 0
         self._tokens_out = 0
         self._prefills = 0
+        self._spec_steps = 0
+        self._spec_slot_steps = 0   # per-slot participations in spec steps
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_draft_ms = 0.0
+        self._spec_verify_ms = 0.0
         if warmup:
             self.warmup()
 
@@ -333,6 +434,145 @@ class DecodeEngine:
 
         return fused
 
+    def _self_draft_fn(self):
+        """Self-speculation draft: an unrolled ``spec_k``-step greedy chain
+        through the target's first ``draft_layers`` blocks, reading and
+        writing the *same* paged pool the verify pass uses — valid because a
+        truncated stack's layer-i K/V is identical to the full stack's, and
+        safe because the verify pass overwrites every chunk position anyway.
+        Writes past a slot's appended room are masked to the scratch page."""
+        model, page, maxp = self.model, self.page_size, self.max_pages_per_slot
+        K, Ld = self.spec_k, self.draft_layers
+        bidx = jnp.arange(self.num_slots)
+
+        def draft(params, k_pool, v_pool, token, pos, table, nappend):
+            writable = pos + nappend        # first position with no room
+
+            def attend(layer, q, k_new, v_new, cache, p):
+                kp, vp = cache
+                pids = table[bidx, jnp.clip(p // page, 0, maxp - 1)]
+                pids = jnp.where(p < writable, pids, 0)
+                off = p % page
+                kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
+                vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
+                out = paged_attention(q, kp[layer], vp[layer], table, p + 1)
+                return out.astype(q.dtype), (kp, vp)
+
+            toks, tok = [], token
+            for j in range(K):
+                logits, (k_pool, v_pool) = model.decode_step(
+                    params, (k_pool, v_pool), tok, pos + j, attend=attend,
+                    num_layers=Ld)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                toks.append(tok)
+            return jnp.stack(toks, axis=1), k_pool, v_pool
+
+        return draft
+
+    def _ext_draft_fn(self):
+        """External-draft chain: the small draft model's greedy ``spec_k``
+        steps over its own dense per-slot cache. Rejected positions leave
+        stale draft K/V behind, but the next chain starting at the commit
+        point overwrites each position before anything attends to it; dead
+        lanes write to the cache's margin row (never attended)."""
+        dm, K = self._draft_model, self.spec_k
+        CL = self._draft_cache_len
+        bidx = jnp.arange(self.num_slots)
+        scale = 1.0 / math.sqrt(dm.head_dim)
+        lpos = jnp.arange(CL, dtype=jnp.int32)
+
+        def draft(params, ck, cv, token, pos, live):
+            def attend(layer, q, k_new, v_new, cache, p):
+                ck, cv = cache
+                p_eff = jnp.where(live, p, CL - 1)
+                k = ck[layer].at[bidx, :, p_eff].set(k_new.astype(ck.dtype))
+                v = cv[layer].at[bidx, :, p_eff].set(v_new.astype(cv.dtype))
+                s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                               k.astype(jnp.float32)) * scale
+                ok = lpos[None, :] <= p[:, None]
+                s = jnp.where(ok[:, None, :], s, -1e30)
+                pr = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhl,bhld->bhd", pr, v.astype(jnp.float32))
+                return (out.astype(q.dtype),
+                        (ck.at[layer].set(k), cv.at[layer].set(v)))
+
+            toks, tok = [], token
+            for j in range(K):
+                logits, (ck, cv) = dm.decode_step(
+                    params, (ck, cv), tok, pos + j, attend=attend)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                toks.append(tok)
+            return jnp.stack(toks, axis=1), ck, cv
+
+        return draft
+
+    def _ext_draft_prefill_fn(self, bucket: int):
+        """Draft-cache prefill for one ladder bucket: forward the (padded)
+        prompt through the draft model and write its K/V into ``slot``'s
+        dense cache lane. Padding garbage past ``length`` is harmless — the
+        first draft chain overwrites position ``length`` before attending."""
+        dm = self._draft_model
+
+        def dprefill(params, ck, cv, ids, length, slot):
+            _logits, kvs = dm.prefill(params, ids, lengths=length)
+            for i, (k, v) in enumerate(kvs):
+                # k/v [1, heads, bucket, d] -> lane update at (i, slot, 0, 0)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k[None].astype(ck.dtype), (i, slot, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v[None].astype(cv.dtype), (i, slot, 0, 0, 0))
+            return ck, cv
+
+        return dprefill
+
+    def _verify_fn(self):
+        """One fixed-shape target call scoring all ``spec_k + 1`` chunk
+        positions per slot: write the chunk's K/V into the slot's pages
+        (lanes masked past ``nvalid`` -> scratch), attend per-query-causally
+        over the whole table (:func:`paged_attention_verify`), and return
+        the greedy argmax at every position plus a sampled token from
+        position 0 (the temperature lanes' bonus — one sampler advance per
+        verify keeps the per-token key cadence of the plain step)."""
+        model, page, maxp = self.model, self.page_size, self.max_pages_per_slot
+        S = self.spec_k + 1
+        bidx = jnp.arange(self.num_slots)
+        j = jnp.arange(S, dtype=jnp.int32)
+
+        def verify(params, k_pool, v_pool, ids, start, nvalid, table, keys,
+                   temp, topk):
+            def attend(layer, q, k_new, v_new, cache, st):
+                kp, vp = cache
+                pos_abs = st[:, None] + j[None, :]             # [B, S]
+                pids = table[bidx[:, None],
+                             jnp.clip(pos_abs // page, 0, maxp - 1)]
+                pids = jnp.where(j[None, :] < nvalid[:, None], pids, 0)
+                off = pos_abs % page
+                kc = jnp.transpose(k_new, (0, 2, 1, 3))    # [B, S, heads, d]
+                vc = jnp.transpose(v_new, (0, 2, 1, 3))
+                kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
+                vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
+                out = paged_attention_verify(q, kp[layer], vp[layer],
+                                             table, st)
+                return out.astype(q.dtype), (kp, vp)
+
+            logits, (k_pool, v_pool) = model.decode_verify(
+                params, ids, start, (k_pool, v_pool), attend)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+            samp0, keys = self._sample_tokens(logits[:, 0], keys, temp, topk)
+            return g, samp0, k_pool, v_pool, keys
+
+        return verify
+
+    def _copy_pages_fn(self, k_pool, v_pool, src, dst):
+        """Rollback COW un-alias: clone pool page ``src`` into ``dst`` (all
+        layers). Compiled once at warmup; reached only when a truncate
+        crosses into a shared page, which in-engine rollback provably never
+        does (the floor is past the shared prompt) — kept so even the
+        pathological path cannot retrace steady state."""
+        k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+        v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+        return k_pool, v_pool
+
     def _param_struct(self):
         return jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
@@ -417,7 +657,80 @@ class DecodeEngine:
                         jax.ShapeDtypeStruct((B,), jnp.float32),
                         jax.ShapeDtypeStruct((B,), i32)).compile()
             self.aot_compiles += 1
+        if self.spec_k:
+            self._warmup_spec_locked(ps, pool, B, maxp)
         guard.mark_steady()
+
+    def _warmup_spec_locked(self, ps, pool, B: int, maxp: int) -> None:
+        guard = self.recompile_guard
+        i32 = jnp.int32
+        S = self.spec_k + 1
+        if self._verify_exe is None:
+            with annotate("serving/decode_compile_verify"):
+                self._verify_exe = jax.jit(
+                    guard.wrap(self._verify_fn()),
+                    donate_argnums=(1, 2)).lower(
+                        ps, pool, pool,
+                        jax.ShapeDtypeStruct((B, S), i32),      # chunk ids
+                        jax.ShapeDtypeStruct((B,), i32),        # start
+                        jax.ShapeDtypeStruct((B,), i32),        # nvalid
+                        jax.ShapeDtypeStruct((B, maxp), i32),
+                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                        jax.ShapeDtypeStruct((B,), jnp.float32),
+                        jax.ShapeDtypeStruct((B,), i32)).compile()
+            self.aot_compiles += 1
+        if self._copy_exe is None:
+            with annotate("serving/decode_compile_copy"):
+                self._copy_exe = jax.jit(
+                    guard.wrap(self._copy_pages_fn),
+                    donate_argnums=(0, 1)).lower(
+                        pool, pool,
+                        jax.ShapeDtypeStruct((), i32),
+                        jax.ShapeDtypeStruct((), i32)).compile()
+            self.aot_compiles += 1
+        if self._draft_model is None:
+            if self._draft_exe is None:
+                with annotate("serving/decode_compile_draft"):
+                    self._draft_exe = jax.jit(
+                        guard.wrap(self._self_draft_fn()),
+                        donate_argnums=(1, 2)).lower(
+                            ps, pool, pool,
+                            jax.ShapeDtypeStruct((B,), i32),    # token
+                            jax.ShapeDtypeStruct((B,), i32),    # pos
+                            jax.ShapeDtypeStruct((B, maxp), i32),
+                            jax.ShapeDtypeStruct((B,), i32)     # nappend
+                            ).compile()
+                self.aot_compiles += 1
+            return
+        dps = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            if not hasattr(a, "aval")
+            else jax.ShapeDtypeStruct(a.shape, a.dtype), self._draft_params)
+        dpool = jax.ShapeDtypeStruct(self._draft_k.shape,
+                                     self._draft_k.dtype)
+        if self._draft_exe is None:
+            with annotate("serving/decode_compile_draft"):
+                self._draft_exe = jax.jit(
+                    guard.wrap(self._ext_draft_fn()),
+                    donate_argnums=(1, 2)).lower(
+                        dps, dpool, dpool,
+                        jax.ShapeDtypeStruct((B,), i32),        # token
+                        jax.ShapeDtypeStruct((B,), i32),        # pos
+                        jax.ShapeDtypeStruct((B,), jnp.bool_)   # live
+                        ).compile()
+            self.aot_compiles += 1
+        for b in self.prefill_buckets:
+            if b in self._draft_prefill_exes:
+                continue
+            with annotate(f"serving/decode_compile_draft_prefill_b{b}"):
+                self._draft_prefill_exes[b] = jax.jit(
+                    guard.wrap(self._ext_draft_prefill_fn(b)),
+                    donate_argnums=(1, 2)).lower(
+                        dps, dpool, dpool,
+                        jax.ShapeDtypeStruct((1, b), i32),
+                        jax.ShapeDtypeStruct((1,), i32),
+                        jax.ShapeDtypeStruct((), i32)).compile()
+            self.aot_compiles += 1
 
     # -- admission / prefill -------------------------------------------------
 
@@ -503,6 +816,10 @@ class DecodeEngine:
                 logits = self._suffix_prefill_locked(slot, prompt, start, n)
             if self.prefix_cache:
                 self.kv.commit_prefix(slot, prompt)  # K/V is on device now
+            if self._draft_model is not None:
+                # the draft keeps its own cache, so prefix hits on the
+                # target side still need a full draft prefill
+                self._draft_prefill_locked(slot, prompt)
             tok, key = self._sample_exe(
                 np.asarray(logits), self._keys[slot][None],
                 np.asarray([temperature], np.float32),
@@ -539,14 +856,32 @@ class DecodeEngine:
             p += c
         return logits
 
+    def _draft_prefill_locked(self, slot: int, prompt: List[int]) -> None:
+        """Fill the external draft's dense cache lane for ``slot`` through
+        its bucket ladder (one bucket call — the draft is small)."""
+        n = len(prompt)
+        bucket = next(b for b in self.prefill_buckets if n <= b)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = prompt
+        with obs_span("serving/decode_draft_prefill",
+                      args={"bucket": bucket, "slot": int(slot)},
+                      jax_annotation=True):
+            self._draft_k, self._draft_v = self._draft_prefill_exes[bucket](
+                self._draft_params, self._draft_k, self._draft_v, ids,
+                np.asarray([n], np.int32), np.int32(slot))
+
     # -- decode --------------------------------------------------------------
 
-    def step(self) -> Dict[int, int]:
-        """One decode iteration over every decode-ready slot: append a
-        token's page room, run the fixed-shape step, return
-        ``{slot: next_token}``. Pending chunked prefills advance one chunk
-        here, fused into the same device call; a slot whose final chunk
-        just committed contributes its *first* token to the result. No-op
+    def step(self) -> Dict[int, List[int]]:
+        """One decode iteration over every decode-ready slot: append page
+        room, run the fixed-shape step, return ``{slot: [tokens...]}`` — a
+        burst of 1 token per slot normally, up to ``spec_k + 1`` with
+        speculation on (the accepted draft prefix plus the target's bonus
+        token, in order). Pending chunked prefills advance one chunk here,
+        fused into the same device call; a slot whose final chunk just
+        committed contributes its *first* token to the result. While a
+        chunk is pending the speculative path stands down for the iteration
+        (plain fused step) so the chunk work stays fused with decode. No-op
         (empty dict) when nothing is active."""
         with self._lock:
             active = self.kv.active_slots()
@@ -555,6 +890,8 @@ class DecodeEngine:
             state = self._pending[0] if self._pending else None
             if ready.size == 0 and state is None:
                 return {}
+            if self.spec_k and state is None:
+                return self._spec_step_locked(ready)
             t0 = time.perf_counter()
             # the incoming token occupies position == current length: make
             # sure its page exists, then pass the PRE-append position
@@ -571,7 +908,7 @@ class DecodeEngine:
             table = table_full.copy()
             table[~mask] = 0
             token = np.where(mask, self._last_token, 0).astype(np.int32)
-            out: Dict[int, int] = {}
+            out: Dict[int, List[int]] = {}
             if state is not None:
                 C = self._chunk_width
                 p, end = state["next"], state["end"]
@@ -595,6 +932,8 @@ class DecodeEngine:
                     slot = state["slot"]
                     if self.prefix_cache:
                         self.kv.commit_prefix(slot, state["prompt"])
+                    if self._draft_model is not None:
+                        self._draft_prefill_locked(slot, state["prompt"])
                     if state["seed"] is not None:
                         # the fused steps advanced every lane's key; re-pin
                         # the requested seed before the first sample
@@ -608,7 +947,7 @@ class DecodeEngine:
                     first = int(np.asarray(ftok)[0])
                     self._last_token[slot] = first
                     self._decode_ready[slot] = True
-                    out[int(slot)] = first
+                    out[int(slot)] = [first]
                     self.metrics.observe(
                         "serving/decode/prefill_ms",
                         (time.perf_counter() - state["t0"]) * 1000.0)
@@ -624,7 +963,7 @@ class DecodeEngine:
             tok = np.asarray(tok)
             for s in ready:
                 self._last_token[s] = tok[s]
-                out[int(s)] = int(tok[s])
+                out[int(s)] = [int(tok[s])]
             self._steps += 1
             self._tokens_out += len(out)
             dt_ms = (time.perf_counter() - t0) * 1000.0
@@ -633,6 +972,109 @@ class DecodeEngine:
                                  int(ready.size))
             self.metrics.observe("serving/decode/token_latency_ms",
                                  dt_ms)  # per-token: one step = one token
+        return out
+
+    def _spec_step_locked(self, ready: np.ndarray) -> Dict[int, List[int]]:
+        """One speculative iteration: clamp each slot's window to its page
+        room (temperature slots to 0), append the whole window's room, run
+        the draft chain then the single verify call, commit the longest
+        matching prefix + bonus per slot, and roll the rest back via
+        :meth:`PagedKVCache.truncate`."""
+        t0 = time.perf_counter()
+        K = self.spec_k
+        B = self.num_slots
+        lengths0 = self.kv.lengths()
+        rooms = self.kv.token_rooms()
+        mask = np.zeros(B, bool)
+        mask[ready] = True
+        kb = np.zeros(B, np.int32)
+        for s in ready:
+            want = K if self._temp[s] == 0.0 else 0
+            kb[s] = max(0, min(want, int(rooms[s]) - 1))
+        nappend = np.where(mask, kb + 1, 0).astype(np.int32)
+        for s in ready:
+            self.kv.append(int(s), int(nappend[s]))
+        table_full = self.kv.page_tables()
+        # chunk base: the incoming token sits at the pre-append length
+        start = np.where(mask, lengths0, 0).astype(np.int32)
+        table = table_full.copy()
+        table[~mask] = 0
+        token = np.where(mask, self._last_token, 0).astype(np.int32)
+
+        td = time.perf_counter()
+        with obs_span("serving/decode_draft",
+                      args={"active": int(ready.size)}, jax_annotation=True):
+            if self._draft_model is None:
+                drafts, self._k_pool, self._v_pool = self._draft_exe(
+                    self._params, self._k_pool, self._v_pool, token, start,
+                    table, nappend)
+            else:
+                drafts, self._draft_k, self._draft_v = self._draft_exe(
+                    self._draft_params, self._draft_k, self._draft_v,
+                    token, start, mask)
+        drafts = np.asarray(drafts)                        # [B, K], blocks
+        draft_ms = (time.perf_counter() - td) * 1000.0
+
+        ids = np.zeros((B, K + 1), np.int32)
+        ids[:, 0] = token
+        ids[:, 1:] = drafts
+        ids[~mask] = 0
+        tv = time.perf_counter()
+        with obs_span("serving/decode_verify",
+                      args={"active": int(ready.size)}, jax_annotation=True):
+            g, samp0, self._k_pool, self._v_pool, self._keys = \
+                self._verify_exe(self._params, self._k_pool, self._v_pool,
+                                 ids, start, nappend, table, self._keys,
+                                 self._temp, self._topk)
+        g = np.asarray(g)                                  # [B, K+1]
+        samp0 = np.asarray(samp0)
+        verify_ms = (time.perf_counter() - tv) * 1000.0
+
+        out: Dict[int, List[int]] = {}
+        committed_total = 0
+        for s in ready:
+            s = int(s)
+            k_b = int(kb[s])
+            a = 0
+            while a < k_b and drafts[s, a] == g[s, a]:
+                a += 1
+            bonus = (int(samp0[s]) if self._temp[s] > 0.0 else int(g[s, a]))
+            copies = self.kv.truncate(s, int(lengths0[s]) + a + 1)
+            for src, dst in copies:
+                self._k_pool, self._v_pool = self._copy_exe(
+                    self._k_pool, self._v_pool, np.int32(src),
+                    np.int32(dst))
+            toks = [int(drafts[s, i]) for i in range(a)] + [bonus]
+            self._last_token[s] = bonus
+            out[s] = toks
+            self._spec_proposed += k_b
+            self._spec_accepted += a
+            committed_total += len(toks)
+
+        self._spec_steps += 1
+        self._spec_slot_steps += int(ready.size)
+        self._steps += 1
+        self._tokens_out += committed_total
+        self._spec_draft_ms = draft_ms
+        self._spec_verify_ms = verify_ms
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe("serving/decode/step_ms", dt_ms)
+        self.metrics.observe("serving/decode/step_active", int(ready.size))
+        self.metrics.observe("serving/decode/draft_ms", draft_ms)
+        self.metrics.observe("serving/decode/verify_ms", verify_ms)
+        # amortized per-token latency: one observation per committed token
+        # so the histogram's percentiles stay per-token like the plain path
+        per_tok = dt_ms / max(1, committed_total)
+        for _ in range(committed_total):
+            self.metrics.observe("serving/decode/token_latency_ms", per_tok)
+        rate = (self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
+        self.metrics.gauge("decode/spec/accept_rate", rate)
+        self.metrics.gauge("decode/spec/mean_accepted",
+                           self._spec_accepted
+                           / max(1, self._spec_slot_steps))
+        self.metrics.gauge("decode/spec/draft_ms", draft_ms)
+        self.metrics.gauge("decode/spec/verify_ms", verify_ms)
         return out
 
     def release(self, slot: int) -> None:
@@ -668,5 +1110,23 @@ class DecodeEngine:
                 "steps": self._steps,
                 "tokens_out": self._tokens_out,
                 "prefills": self._prefills,
+                "spec": {
+                    "enabled": bool(self.spec_k),
+                    "k": self.spec_k,
+                    "mode": ("external" if self._draft_model is not None
+                             else ("self" if self.spec_k else None)),
+                    "draft_layers": self.draft_layers,
+                    "steps": self._spec_steps,
+                    "proposed": self._spec_proposed,
+                    "accepted": self._spec_accepted,
+                    "accept_rate": (self._spec_accepted / self._spec_proposed
+                                    if self._spec_proposed else 0.0),
+                    # mean draft tokens accepted per slot per spec step
+                    "mean_accepted": (self._spec_accepted
+                                      / self._spec_slot_steps
+                                      if self._spec_slot_steps else 0.0),
+                    "draft_ms": self._spec_draft_ms,
+                    "verify_ms": self._spec_verify_ms,
+                },
                 "kv": self.kv.stats(),
             }

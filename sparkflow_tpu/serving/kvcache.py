@@ -342,6 +342,76 @@ class PagedKVCache:
                 self._lengths[slot] = length + 1
             self._export_gauges_locked()
 
+    def truncate(self, slot: int, n: int) -> List[tuple]:
+        """Roll ``slot`` back to ``n`` tokens (speculative-decode rejection).
+
+        Pages wholly past the new boundary drop one reference — the exact
+        release path of :meth:`free`, so shared pages just lose our alias and
+        exclusively-held ones return to the pool (cached tier when still
+        indexed). Released pages go back into the slot's *reservation*, so a
+        later :meth:`append` re-draws them without new admission — accept /
+        reject churn is pool-neutral.
+
+        The new tail page is special: if ``n`` is mid-page the slot will keep
+        writing into it, and writing a **shared** page would corrupt every
+        other reader — so a shared tail is un-aliased through the COW path
+        (a fresh private page is drawn and the caller is told to copy the
+        contents). Returns a list of ``(src_pid, dst_pid)`` pairs the caller
+        must apply to the device pool before the next write; empty in the
+        common all-private case. An indexed-but-exclusive tail is instead
+        deregistered from the prefix index (its future contents diverge from
+        what the index advertises).
+
+        The un-alias draw is not covered by the admission reservation (shared
+        pages were mapped, not reserved), so it can pathologically raise
+        :class:`OutOfPages` on an exhausted pool. The engine never hits this:
+        speculative rollback floors at the first *generated* token, which is
+        always past the shared prompt pages.
+        """
+        n = int(n)
+        copies: List[tuple] = []
+        with self._lock:
+            if not self._active[slot]:
+                raise ValueError(f"slot {slot} is not active")
+            length = int(self._lengths[slot])
+            if not 1 <= n <= length:
+                raise ValueError(
+                    f"truncate to {n} outside [1, {length}] for slot {slot}")
+            if n == length:
+                return copies
+            held = int(self._pages_held[slot])
+            keep = self.pages_for(n, self.page_size)
+            for i in range(keep, held):
+                pid = int(self._tables[slot, i])
+                self._refcount[pid] -= 1
+                if self._refcount[pid] <= 0:
+                    self._refcount[pid] = 0
+                    if pid in self._page_key:
+                        self._cached[pid] = None
+                        self._cached.move_to_end(pid)
+                    else:
+                        self._free.append(pid)
+                self._tables[slot, i] = 0
+            self._pages_held[slot] = keep
+            self._reserved[slot] += held - keep
+            if n % self.page_size != 0:
+                # the tail page will receive this slot's future writes
+                pid = int(self._tables[slot, keep - 1])
+                if self._refcount[pid] > 1:
+                    dst = self._take_page_locked()
+                    self._refcount[dst] = 1
+                    self._refcount[pid] -= 1
+                    self._tables[slot, keep - 1] = dst
+                    copies.append((pid, dst))
+                    self.metrics.incr("serving/kv/cow_unaliases")
+                elif pid in self._page_key:
+                    dg = self._page_key.pop(pid)
+                    self._prefix_index.pop(dg, None)
+            self._lengths[slot] = n
+            self.metrics.incr("serving/kv/truncations")
+            self._export_gauges_locked()
+            return copies
+
     def free(self, slot: int) -> None:
         """Retire ``slot``: drop one reference from each held page; pages
         reaching refcount 0 return to the pool — straight to the free list,
@@ -380,6 +450,16 @@ class PagedKVCache:
         """``[num_slots]`` int32 tokens per slot (0 for inactive)."""
         with self._lock:
             return self._lengths.copy()
+
+    def token_rooms(self) -> np.ndarray:
+        """``[num_slots]`` int32 — tokens each slot can still append without
+        outgrowing its admission reservation (``(held + reserved) * page_size
+        - length``; 0 for inactive slots). The speculative decoder clamps its
+        per-slot window to this so mid-burst appends never fail."""
+        with self._lock:
+            room = ((self._pages_held.astype(np.int64) + self._reserved)
+                    * self.page_size - self._lengths)
+            return np.where(self._active, room, 0).astype(np.int32)
 
     def active_slots(self) -> np.ndarray:
         with self._lock:

@@ -176,6 +176,9 @@ class Replica:
         # unknown (no decode plane on the replica, or not yet probed)
         self.decode_free_slots = -1
         self.decode_pages_free = -1
+        # speculative-decode acceptance rate from /healthz; -1 = speculation
+        # off on the replica (or not yet probed)
+        self.decode_spec_accept_rate = -1.0
         self.successes = 0
         self.failures = 0
         self.hedges = 0              # hedge requests sent to this replica
@@ -265,9 +268,12 @@ class Membership:
                 if isinstance(dec, dict):
                     replica.decode_free_slots = int(dec.get("free_slots", -1))
                     replica.decode_pages_free = int(dec.get("pages_free", -1))
+                    replica.decode_spec_accept_rate = float(
+                        dec.get("spec_accept_rate", -1.0))
                 else:
                     replica.decode_free_slots = -1
                     replica.decode_pages_free = -1
+                    replica.decode_spec_accept_rate = -1.0
         if ok:
             # a live /healthz is recovery evidence: without it an ejected
             # replica on an idle fleet stays OPEN forever, because half-open
@@ -378,6 +384,7 @@ class Membership:
                          reported_in_flight=r.reported_in_flight,
                          decode_free_slots=r.decode_free_slots,
                          decode_pages_free=r.decode_pages_free,
+                         decode_spec_accept_rate=r.decode_spec_accept_rate,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
                     for r in self._replicas]
@@ -402,3 +409,5 @@ class Membership:
             self.metrics.gauge(f"{prefix}/hedges", float(row["hedges"]))
             self.metrics.gauge(f"{prefix}/kv_pages_free",
                                float(row["decode_pages_free"]))
+            self.metrics.gauge(f"{prefix}/spec_accept_rate",
+                               float(row["decode_spec_accept_rate"]))

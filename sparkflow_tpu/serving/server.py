@@ -398,6 +398,14 @@ class InferenceServer:
                 "pages_free": int(kv.get("pages_free", 0)),
                 "engine": gstats,
             }
+            spec = gstats.get("spec", {}) if isinstance(gstats, dict) else {}
+            if spec.get("enabled"):
+                # speculative health: routers/membership can prefer replicas
+                # whose drafts are actually being accepted
+                body["decode"]["spec_accept_rate"] = float(
+                    spec.get("accept_rate", 0.0))
+                body["decode"]["spec_mean_accepted"] = float(
+                    spec.get("mean_accepted", 0.0))
         if state in (ServerState.SERVING, ServerState.STARTING):
             return 200, body, None
         # draining/stopped: flip readiness so the load balancer ejects this

@@ -22,7 +22,9 @@ import jax.numpy as jnp
 
 from sparkflow_tpu.analysis import locks
 from sparkflow_tpu.models.registry import build_registry_spec, model_from_json
-from sparkflow_tpu.ops import paged_attention, paged_attention_reference
+from sparkflow_tpu.ops import (paged_attention, paged_attention_reference,
+                               paged_attention_verify,
+                               paged_attention_verify_reference)
 from sparkflow_tpu.ops.attention import last_attention_path
 from sparkflow_tpu.serving import (ContinuousBatcher, DecodeEngine, Draining,
                                    InferenceServer, OutOfPages, PagedKVCache,
@@ -113,6 +115,97 @@ def test_paged_attention_aliased_pages_share_prefix():
     lens = np.asarray([12, 12], np.int32)
     out = np.asarray(paged_attention(q, k, v, table, lens, interpret=True))
     np.testing.assert_allclose(out[0], out[1], atol=1e-6)
+
+
+# -- multi-query verify kernel ------------------------------------------------
+
+
+def _rand_paged_verify(rs, b, h, s, d, page_size, max_pages, starts):
+    """Random multi-query chunk + pools + tables: slot i's chunk begins at
+    absolute position ``starts[i]``, so its pages must cover
+    ``starts[i] + s`` tokens."""
+    num_pages = 1 + b * max_pages
+    q = rs.randn(b, h, s, d).astype(np.float32)
+    k = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    v = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    table = np.zeros((b, max_pages), np.int32)
+    nxt = 1
+    for i, st in enumerate(starts):
+        for p in range((st + s + page_size - 1) // page_size):
+            table[i, p] = nxt
+            nxt += 1
+    return q, k, v, table, np.asarray(starts, np.int32)
+
+
+@pytest.mark.parametrize("page_size", [4, 8])
+def test_paged_verify_parity_ragged_starts(page_size):
+    """Pallas verify kernel == jnp reference across ragged chunk starts,
+    including a chunk at position 0 (no committed history at all)."""
+    rs = np.random.RandomState(page_size)
+    b, h, s, d, max_pages = 4, 4, 4, 16, 4
+    starts = [0, 1, page_size - 1, 2 * page_size + 3]
+    q, k, v, table, st = _rand_paged_verify(rs, b, h, s, d, page_size,
+                                            max_pages, starts)
+    ref = paged_attention_verify_reference(q, k, v, table, st)
+    out = paged_attention_verify(q, k, v, table, st, interpret=True)
+    assert last_attention_path() == "pallas"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_paged_verify_reference_matches_dense_softmax():
+    """The verify reference checked against a from-scratch per-query causal
+    dense attention over the gathered pages (independent derivation)."""
+    rs = np.random.RandomState(5)
+    b, h, s, d, page_size, max_pages = 2, 2, 3, 8, 4, 4
+    starts = [2, 6]
+    q, k, v, table, st = _rand_paged_verify(rs, b, h, s, d, page_size,
+                                            max_pages, starts)
+    ref = np.asarray(paged_attention_verify_reference(q, k, v, table, st))
+    for i in range(b):
+        hist = k[table[i]].reshape(-1, h, d)
+        vv = v[table[i]].reshape(-1, h, d)
+        for j in range(s):
+            ln = starts[i] + j + 1          # query j sees positions <= its own
+            sc = np.einsum("hd,lhd->hl", q[i, :, j], hist[:ln]) / np.sqrt(d)
+            p = np.exp(sc - sc.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            o = np.einsum("hl,lhd->hd", p, vv[:ln])
+            np.testing.assert_allclose(ref[i, :, j], o, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_verify_s1_matches_single_query_kernel():
+    """A one-position chunk is exactly the single-token decode attention:
+    verify(S=1, start=L) == paged_attention(lengths=L+1)."""
+    rs = np.random.RandomState(9)
+    b, h, d, page_size, max_pages = 3, 4, 16, 8, 3
+    lengths = [1, 9, 17]                    # committed history + the query
+    q1, k, v, table, lens = _rand_paged(rs, b, h, d, page_size, max_pages,
+                                        lengths)
+    single = np.asarray(paged_attention(q1, k, v, table, lens,
+                                        interpret=True))
+    multi = np.asarray(paged_attention_verify(
+        q1[:, :, None, :], k, v, table, lens - 1, interpret=True))
+    np.testing.assert_allclose(multi[:, :, 0], single, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_verify_ignores_garbage_beyond_chunk():
+    """K/V past the chunk's last position (stale page remainder — exactly
+    what a rejected speculative suffix leaves behind) must not leak into any
+    query's output."""
+    rs = np.random.RandomState(13)
+    b, h, s, d, page_size, max_pages = 1, 2, 3, 8, 8, 2
+    q, k, v, table, st = _rand_paged_verify(rs, b, h, s, d, page_size,
+                                            max_pages, [7])
+    out1 = np.asarray(paged_attention_verify(q, k, v, table, st,
+                                             interpret=True))
+    k2, v2 = k.copy(), v.copy()
+    k2[table[0, 1], 2:] = 77.0              # positions >= 10 > 7 + 3 - 1
+    v2[table[0, 1], 2:] = -77.0
+    out2 = np.asarray(paged_attention_verify(q, k2, v2, table, st,
+                                             interpret=True))
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
 
 
 # -- page pool ---------------------------------------------------------------
@@ -306,6 +399,143 @@ def test_kvcache_no_leak_under_prefix_churn():
     assert st["prefix_hits"] > 0  # the churn actually exercised sharing
 
 
+# -- speculative rollback: truncate -------------------------------------------
+
+
+def test_kvcache_truncate_basic_and_reservation_neutral():
+    """Rollback releases whole pages past the boundary back into the
+    RESERVATION (not the pool), so accept/reject churn re-draws them without
+    new admission; no-op and bounds behavior pinned."""
+    kv = PagedKVCache(num_pages=9, page_size=4, num_slots=2,
+                      max_pages_per_slot=4)
+    kv.alloc(0, prompt_tokens=6, total_tokens=16)  # holds 2, reserves 2
+    kv.append(0, 5)                                # 11 tokens -> 3 pages
+    assert kv.length(0) == 11 and kv.stats()["pages_used"] == 3
+    assert kv.truncate(0, 7) == []                 # all-private: no copies
+    assert kv.length(0) == 7 and kv.stats()["pages_used"] == 2
+    # the released page is reservation again: growth to the admitted worst
+    # case still never raises, and past it still does
+    kv.append(0, 9)                                # 7 -> 16, the reservation
+    assert kv.length(0) == 16
+    with pytest.raises(OutOfPages):
+        kv.append(0)
+    assert kv.truncate(0, 16) == []                # n == length: no-op
+    with pytest.raises(ValueError):
+        kv.truncate(0, 0)
+    with pytest.raises(ValueError):
+        kv.truncate(0, 17)
+    with pytest.raises(ValueError):
+        kv.truncate(1, 1)                          # inactive slot
+    kv.free(0)
+    assert kv.stats()["pages_used"] == 0 and kv.stats()["pages_free"] == 8
+
+
+def test_kvcache_truncate_shared_tail_cow_unalias():
+    """A rollback whose new tail lands mid a SHARED page must un-alias it
+    via the COW path — the truncating slot gets a private page to write,
+    the other owner keeps the original, and the caller is told to copy."""
+    m = Metrics()
+    kv = PagedKVCache(num_pages=17, page_size=4, num_slots=3,
+                      max_pages_per_slot=4, metrics=m)
+    base = [7, 7, 7, 7, 1, 2, 3, 4]                # two full blocks
+    kv.alloc(0, base, 12)
+    kv.commit_prefix(0, base)
+    shared, _ = kv.alloc(1, base + [9], 12)
+    assert shared == 2
+    t = kv.page_tables().copy()
+    copies = kv.truncate(1, 6)                     # mid the shared 2nd page
+    assert len(copies) == 1
+    src, dst = copies[0]
+    assert src == t[1, 1] and dst != src
+    t2 = kv.page_tables()
+    assert t2[1, 1] == dst and t2[0, 1] == src     # slot 0 untouched
+    rc = kv.refcounts()
+    assert rc[src] == 1 and rc[dst] == 1 and rc[t2[0, 0]] == 2
+    assert m.summary()["counters"]["serving/kv/cow_unaliases"] == 1
+    kv.free(0)
+    kv.free(1)
+    assert (kv.refcounts() == 0).all()
+    assert kv.stats()["pages_used"] == 0
+
+
+def test_kvcache_truncate_deregisters_indexed_exclusive_tail():
+    """Rolling back mid an indexed-but-exclusive page deregisters it from
+    the prefix index: the slot is about to overwrite contents the index
+    still advertises."""
+    kv = PagedKVCache(num_pages=9, page_size=4, num_slots=2,
+                      max_pages_per_slot=2)
+    base = [5, 6, 7, 8, 9, 10, 11, 12]
+    kv.alloc(0, base, 8)
+    kv.commit_prefix(0, base)                      # both blocks indexed
+    assert kv.truncate(0, 6) == []                 # exclusive: no copy
+    kv.free(0)
+    shared, _ = kv.alloc(1, base, 8)               # replay the same prompt
+    assert shared == 1                             # only block 0 survives
+    kv.free(1)
+
+
+def test_kvcache_truncate_no_leak_under_spec_churn():
+    """200 iterations of speculative append-k / accept-a / truncate churn
+    with prefix sharing in the mix: refcount conservation holds every
+    iteration (sum of refcounts == live table entries) and the pool drains
+    clean."""
+    kv = PagedKVCache(num_pages=33, page_size=4, num_slots=4,
+                      max_pages_per_slot=8)
+    rs = np.random.RandomState(2)
+    prefixes = [list(rs.randint(1, 50, size=8)) for _ in range(2)]
+    live = {}
+    for _ in range(200):
+        slot = kv.free_slot()
+        if slot is not None and rs.rand() < 0.5:
+            pref = prefixes[rs.randint(len(prefixes))]
+            prompt = pref + [int(x) for x in
+                             rs.randint(1, 50, size=rs.randint(1, 5))]
+            total = len(prompt) + int(rs.randint(4, 12))
+            if kv.can_admit(total, prompt):
+                kv.alloc(slot, prompt, total)
+                kv.commit_prefix(slot, prompt)
+                live[slot] = total
+        for s in list(live):
+            ln, total = kv.length(s), live[s]
+            room = total - ln
+            if room <= 0 or rs.rand() < 0.2:
+                kv.free(s)
+                del live[s]
+                continue
+            k = int(min(room, 1 + rs.randint(4)))  # speculative window
+            kv.append(s, k)
+            a = int(rs.randint(1, k + 1))          # accepted prefix
+            kv.truncate(s, ln + a)                 # no-op when a == k
+        rc = kv.refcounts()
+        assert (rc >= 0).all()
+        tables = kv.page_tables()
+        held_entries = int(np.count_nonzero(tables[sorted(live)])) \
+            if live else 0
+        assert int(rc.sum()) == held_entries, "refcount conservation broken"
+    for s in list(live):
+        kv.free(s)
+    st = kv.stats()
+    assert st["pages_used"] == 0 and st["pages_reserved"] == 0
+    assert st["pages_free"] == 32 and st["tokens"] == 0
+    assert (kv.refcounts() == 0).all()
+
+
+def test_kvcache_token_rooms():
+    """token_rooms = committed-capacity headroom per slot: (held + reserved)
+    pages minus the current length; zero for inactive lanes."""
+    kv = PagedKVCache(num_pages=9, page_size=4, num_slots=2,
+                      max_pages_per_slot=4)
+    kv.alloc(0, prompt_tokens=6, total_tokens=14)  # held 2, reserved 2
+    rooms = kv.token_rooms()
+    assert rooms[0] == 10 and rooms[1] == 0
+    kv.append(0, 2)
+    assert kv.token_rooms()[0] == 8
+    kv.truncate(0, 5)
+    assert kv.token_rooms()[0] == 11
+    kv.free(0)
+    assert (kv.token_rooms() == 0).all()
+
+
 # -- decode engine ------------------------------------------------------------
 
 
@@ -365,7 +595,7 @@ def test_engine_greedy_parity_and_zero_retrace(engine, lm):
     info = engine.prefill(prompt, max_new_tokens=6, temperature=0.0)
     toks = [info["token"]]
     for _ in range(5):
-        toks.append(engine.step()[info["slot"]])
+        toks.extend(engine.step()[info["slot"]])
     engine.release(info["slot"])
     assert toks == _dense_greedy(model, params, prompt, 6)
     st = engine.stats()
@@ -377,12 +607,12 @@ def test_engine_sampling_reproducible_and_varied(engine):
     r1 = [engine.prefill([4, 4], max_new_tokens=4, temperature=1.0,
                          top_k=8, seed=123)]
     for _ in range(3):
-        r1.append(engine.step()[r1[0]["slot"]])
+        r1.extend(engine.step()[r1[0]["slot"]])
     engine.release(r1[0]["slot"])
     r2 = [engine.prefill([4, 4], max_new_tokens=4, temperature=1.0,
                          top_k=8, seed=123)]
     for _ in range(3):
-        r2.append(engine.step()[r2[0]["slot"]])
+        r2.extend(engine.step()[r2[0]["slot"]])
     engine.release(r2[0]["slot"])
     t1 = [r1[0]["token"]] + r1[1:]
     t2 = [r2[0]["token"]] + r2[1:]
@@ -415,9 +645,9 @@ def _engine_greedy(eng, prompt, n):
     while len(toks) < n:
         out = eng.step()
         if info["slot"] in out:
-            toks.append(out[info["slot"]])
+            toks.extend(out[info["slot"]])
     eng.release(info["slot"])
-    return toks, info
+    return toks[:n], info
 
 
 def test_engine_prefix_sharing_greedy_parity(lm):
@@ -462,10 +692,10 @@ def test_chunked_prefill_keeps_decode_cadence(engine_chunked, lm):
     for i in range(19):
         out = eng.step()
         assert a["slot"] in out, f"decode cadence broken at step {i}"
-        toks_a.append(out[a["slot"]])
+        toks_a.extend(out[a["slot"]])
         if b["slot"] in out and len(toks_b) < 4:
             first_b = i if first_b is None else first_b
-            toks_b.append(out[b["slot"]])
+            toks_b.extend(out[b["slot"]])
             if len(toks_b) == 4:
                 eng.release(b["slot"])
     eng.release(a["slot"])
@@ -496,6 +726,161 @@ def test_continuous_batching_shared_prefix_parity(engine_chunked, lm):
         assert engine_chunked.stats()["steady_traces"] == 0
         assert engine_chunked.kv.stats()["prefix_hits"] >= 1
         assert engine_chunked.kv.stats()["slots_active"] == 0
+    finally:
+        cb.close()
+
+
+# -- speculative decoding -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_spec(lm):
+    """One spec engine for the whole section (compiles are the cost):
+    chunking only engages for prompts past the chunk threshold, so the
+    short-prompt tests see plain speculative behavior on the same engine."""
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, spec_k=3)
+
+
+@pytest.fixture(scope="module")
+def draft_lm():
+    spec = build_registry_spec("transformer_lm", vocab_size=VOCAB, hidden=16,
+                               num_layers=1, num_heads=2, mlp_dim=32,
+                               max_len=32, dropout=0.0)
+    dm = model_from_json(spec)
+    return dm, dm.init(jax.random.PRNGKey(3))
+
+
+def test_spec_greedy_parity_self_draft(engine_spec, lm):
+    """Self-speculation must be a pure schedule change: every greedy token
+    identical to the dense forward, zero steady-state retraces, and the
+    stats block alive."""
+    model, params = lm
+    for prompt in ([5, 2, 8], [3]):
+        toks, _ = _engine_greedy(engine_spec, prompt, 8)
+        assert toks == _dense_greedy(model, params, prompt, 8)
+    st = engine_spec.stats()
+    assert st["steady_traces"] == 0
+    sp = st["spec"]
+    assert sp["enabled"] and sp["mode"] == "self" and sp["steps"] > 0
+    assert sp["proposed"] > 0 and 0.0 <= sp["accept_rate"] <= 1.0
+    assert 0.0 <= sp["mean_accepted"] <= engine_spec.spec_k
+
+
+def test_spec_step_burst_contract(engine_spec):
+    """step() returns 1..k+1 tokens per live slot and tokens_out accounts
+    for every burst token."""
+    eng = engine_spec
+    before = eng.stats()["tokens_out"]
+    infos = [eng.prefill([i + 1, i + 2], max_new_tokens=12, temperature=0.0)
+             for i in range(2)]
+    n = 0  # tokens_out counts step-produced tokens; prefill's is separate
+    for _ in range(3):
+        out = eng.step()
+        assert set(out) == {i["slot"] for i in infos}
+        for burst in out.values():
+            assert 1 <= len(burst) <= eng.spec_k + 1
+            n += len(burst)
+    for i in infos:
+        eng.release(i["slot"])
+    assert eng.stats()["tokens_out"] - before == n
+
+
+def test_spec_parity_with_prefix_hits_and_chunked_prefill(engine_spec, lm):
+    """Speculation composed with BOTH shared-prefix caching and chunked
+    prefill: replayed system prompts hit the prefix cache, a long prompt
+    prefills in chunks, and every token stays greedy-exact."""
+    model, params = lm
+    eng = engine_spec
+    sysp = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13, 12, 10]
+    prompts = [sysp + [17, 18],
+               list(range(1, 25))]  # 24 tokens: chunked admission
+    for p in prompts:
+        toks, _ = _engine_greedy(eng, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6)
+    # replay: prefix hit and speculation in the same request
+    toks, info = _engine_greedy(eng, sysp + [17, 18], 6)
+    assert info["shared_tokens"] == 8
+    assert toks == _dense_greedy(model, params, sysp + [17, 18], 6)
+    st = eng.stats()
+    assert eng.kv.stats()["prefix_hits"] >= 1
+    assert st["steady_traces"] == 0 and st["pending_prefills"] == 0
+    assert st["spec"]["steps"] > 0
+
+
+def test_spec_greedy_parity_external_draft(lm, draft_lm):
+    """A separately supplied small draft model proposes; the target's
+    verify keeps the text greedy-exact even when most drafts are rejected
+    (the rollback/truncate path runs constantly here)."""
+    model, params = lm
+    dm, dparams = draft_lm
+    eng = DecodeEngine(model, params, num_slots=2, page_size=8, seed=0,
+                       spec_k=2, draft_model=dm, draft_params=dparams)
+    for prompt in ([5, 2, 8], [4, 4]):
+        toks, _ = _engine_greedy(eng, prompt, 8)
+        assert toks == _dense_greedy(model, params, prompt, 8)
+    st = eng.stats()
+    assert st["spec"]["mode"] == "external"
+    assert st["steady_traces"] == 0
+
+
+def test_spec_ctor_validation(lm, draft_lm):
+    model, params = lm
+    dm, dparams = draft_lm
+    with pytest.raises(ValueError):  # draft knobs without spec_k
+        DecodeEngine(model, params, num_slots=2, page_size=8,
+                     draft_layers=1, warmup=False)
+    with pytest.raises(ValueError):  # external draft without its params
+        DecodeEngine(model, params, num_slots=2, page_size=8, spec_k=2,
+                     draft_model=dm, warmup=False)
+    with pytest.raises(ValueError):  # truncated stack deeper than the model
+        DecodeEngine(model, params, num_slots=2, page_size=8, spec_k=2,
+                     draft_layers=5, warmup=False)
+
+
+def test_batcher_timing_decomposition_with_bursts(engine_spec, lm):
+    """Per-request timing legs must sum exactly to the total with
+    multi-token speculative bursts and queue waits in play — the old
+    decomposition charged queue wait to prefill and assumed one token per
+    step."""
+    cb = ContinuousBatcher(engine_spec, max_queue=16)
+    try:
+        futs = [cb.submit([i + 1, i + 2, i + 3], max_new_tokens=5,
+                          temperature=0.0) for i in range(6)]
+        for f in futs:
+            r = f.result(timeout=120)
+            assert r["num_tokens"] == 5  # burst overshoot discarded
+            t = f.timing
+            assert t["tokens"] == 5
+            assert t["queue_wait_ms"] >= 0.0 and t["prefill_ms"] > 0.0
+            assert t["decode_ms"] >= 0.0
+            assert (t["queue_wait_ms"] + t["prefill_ms"] + t["decode_ms"]
+                    == pytest.approx(t["total_ms"], abs=1e-6))
+        # 6 requests over 4 slots: somebody actually waited in the queue
+        assert any(f.timing["queue_wait_ms"] > 0.0 for f in futs)
+        assert engine_spec.kv.stats()["slots_active"] == 0
+    finally:
+        cb.close()
+
+
+def test_batcher_eos_mid_burst_discards_remainder(engine_spec, lm):
+    """eos landing inside a speculative burst retires the request at the
+    eos token; the burst remainder is discarded, not delivered. The tiny
+    model greedy-decodes to a fixed point, so the self-draft accepts in
+    full: the first step burst carries spec_k + 1 tokens and eos fires on
+    its first one — without mid-burst retirement the response would carry
+    the whole burst."""
+    model, params = lm
+    ref = _dense_greedy(model, params, [5, 2, 8], 12)
+    eos = ref[1]  # prefill's first token is (by design) not eos-checked
+    cb = ContinuousBatcher(engine_spec, max_queue=8)
+    try:
+        r = cb.generate([5, 2, 8], max_new_tokens=20, eos_id=eos,
+                        timeout=120)
+        assert r["tokens"] == ref[:2]
+        assert r["num_tokens"] == 2
+        assert r["finish_reason"] == "eos"
     finally:
         cb.close()
 
@@ -668,7 +1053,8 @@ SERVING_DIR = os.path.join(os.path.dirname(__file__), os.pardir,
                            "sparkflow_tpu", "serving")
 
 
-@pytest.mark.parametrize("fname", ["kvcache.py", "decode.py", "batcher.py"])
+@pytest.mark.parametrize("fname", ["kvcache.py", "decode.py", "batcher.py",
+                                   "server.py", "membership.py"])
 def test_lock_lint_clean(fname):
     """GC-L301/302/303: every shared-state write in the new serving files
     must happen under the owning lock."""

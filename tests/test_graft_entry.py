@@ -5,6 +5,7 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 
 def test_entry_forward_jits():
@@ -17,6 +18,8 @@ def test_entry_forward_jits():
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+@pytest.mark.slow  # ~90s: full 8-virtual-device dryrun subprocess; run
+# by path when touching __graft_entry__ or the multichip bootstrap
 def test_dryrun_multichip_8():
     sys.path.insert(0, ".")
     import __graft_entry__ as g

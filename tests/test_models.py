@@ -192,6 +192,8 @@ def test_resnet_variants():
     assert 20e6 < total < 30e6  # ResNet-50 ~23.5M params
 
 
+@pytest.mark.slow  # ~80s: full resnet-18 Trainer fit; run by path when
+# touching models/resnet or conv lowering
 def test_resnet_trains_via_trainer():
     spec = build_registry_spec("resnet", num_classes=2, depth=18, image_size=8)
     rs = np.random.RandomState(0)

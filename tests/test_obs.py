@@ -296,6 +296,40 @@ def test_decode_gauges_prometheus_exposition():
     assert "decode_tokens_saved 4" in text
 
 
+def test_spec_decode_gauges_prometheus_exposition():
+    """A speculative decode step publishes the spec gauges (accept rate,
+    mean accepted, draft/verify latency) and they land in the Prometheus
+    text, consistent with the engine's stats() block."""
+    import jax
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    from sparkflow_tpu.serving.decode import DecodeEngine
+    spec = build_registry_spec("transformer_lm", vocab_size=17, hidden=8,
+                               num_layers=2, num_heads=2, mlp_dim=16,
+                               max_len=16, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    m = Metrics()
+    eng = DecodeEngine(model, params, num_slots=2, page_size=4, seed=0,
+                       spec_k=2, metrics=m)
+    info = eng.prefill([3, 1, 4], max_new_tokens=8)
+    got = [info["token"]]
+    while len(got) < 6:
+        out = eng.step()
+        got.extend(out.get(info["slot"], []))
+    eng.release(info["slot"])
+    st = eng.stats()["spec"]
+    assert st["enabled"] and st["steps"] > 0
+    text = prometheus_text(m)
+    for fam in ("decode_spec_accept_rate", "decode_spec_mean_accepted",
+                "decode_spec_draft_ms", "decode_spec_verify_ms"):
+        assert f"# TYPE {fam} gauge" in text, fam
+    mrate = re.search(r"^decode_spec_accept_rate ([0-9.e+-]+)$", text,
+                      re.MULTILINE)
+    assert mrate is not None
+    assert abs(float(mrate.group(1)) - st["accept_rate"]) < 1e-9
+
+
 # -- memory watcher ----------------------------------------------------------
 
 def test_memory_watcher_sample_publishes_gauges():

@@ -300,6 +300,8 @@ def _tiny_clf_spec():
                                dropout=0.0)
 
 
+@pytest.mark.slow  # ~130s: two full pp-schedule fits + a default fit;
+# run by path when touching parallel/pp or the trainer mesh plumbing
 def test_trainer_pp_mesh_matches_default():
     """meshShape-style 'pp' axis on the Trainer: the pipeline fit's weights
     equal the default fit's (the pp step runs inside the same shuffle/batch
@@ -702,6 +704,8 @@ def test_divergence_detection(caplog):
     assert not np.isfinite(r2.losses[-1])
 
 
+@pytest.mark.slow  # ~55s: tp-mesh fit + single-device fit; run by path
+# when touching tp sharding or predict_fn placement inference
 def test_sharded_params_serve_in_place():
     """A tp-mesh-trained Trainer's predict_fn infers the params' own
     shardings: the tp-placed tree serves without an all-gather and matches
