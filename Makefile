@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke zero-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke zero-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -90,6 +90,16 @@ spec-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/spec_smoke.py
 	JAX_PLATFORMS=cpu python bench.py --spec-decode
+
+# tensor-parallel serving smoke: the decode test suite, then a real server
+# subprocess hosting a tp=2 mesh-sharded engine (spec decode + prefix cache
+# on) — a concurrent mixed-length greedy burst must be token-identical to a
+# tp=1 engine, zero steady-state retraces, clean SIGTERM drain; finishes
+# with the tp=1 vs tp=2 decode benchmark (docs/serving.md)
+tp-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/tp_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --tp-decode
 
 # chaos suite: deterministic fault injection against checkpoints, resume,
 # coordinator joins, and serving drain (docs/resilience.md)

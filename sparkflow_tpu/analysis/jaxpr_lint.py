@@ -36,7 +36,17 @@ GC-J106  sharding-config-   the collectives actually present in a train
                             update silently degraded); a ``zero_stage=0``
                             config whose step runs scatter machinery is
                             mislabeled and will checkpoint/restore with
-                            the wrong layout assumptions.
+                            the wrong layout assumptions. The same rule
+                            covers the decode plane
+                            (:func:`lint_decode_step`): an engine that
+                            declares ``tp_axis``/``ep_axis`` must show a
+                            ``psum`` over that axis in its decode-step
+                            jaxpr (the rejoin after the O-projection / MoE
+                            combine — without it each shard keeps partial
+                            activations and the logits are garbage), and a
+                            TP-less engine must show none (a collective
+                            the config doesn't declare means the program
+                            and its memory/latency model disagree).
 """
 
 from __future__ import annotations
@@ -50,6 +60,7 @@ from jax.sharding import PartitionSpec as P
 from .findings import Finding
 
 __all__ = ["lint_fn", "lint_train_step", "lint_sharding_config",
+           "lint_decode_collectives", "lint_decode_step",
            "lint_dp_train_step", "repo_self_check"]
 
 #: collective primitives whose presence/absence encodes the zero stage
@@ -438,6 +449,103 @@ def lint_sharding_config(fn: Callable, args: Sequence, sharding, *,
             source="jaxpr_lint",
             detail={"declared": cfg.describe(), "observed": scatters}))
     return findings
+
+
+def lint_decode_collectives(fn: Callable, args: Sequence, *,
+                            mesh=None, in_specs=None, out_specs=None,
+                            tp_axis: Optional[str] = None,
+                            ep_axis: Optional[str] = None,
+                            name: Optional[str] = None,
+                            ignore: Sequence[str] = ()) -> List[Finding]:
+    """GC-J106 over one decode-plane executable body.
+
+    ``fn`` is the per-shard step function; with ``mesh``/``in_specs`` given
+    it is traced under the same shard_map wrapper the engine compiles
+    (axis-bound psums only trace inside one). The check is direction-exact:
+
+    - a declared ``tp_axis``/``ep_axis`` must appear among the axes of the
+      step's reduction collectives — that psum IS the rejoin after the
+      O-projection / MoE combine, and a step without it ships per-shard
+      partial activations into the logits;
+    - an axis NOT declared must not appear — an undeclared collective means
+      the compiled program and the config everyone budgets from disagree.
+    """
+    if "GC-J106" in set(ignore):
+        return []
+    label = name or getattr(fn, "__name__", "decode_step")
+    args = tuple(jax.tree.map(_struct_like, a) for a in args)
+    if mesh is not None and in_specs is not None:
+        from ..jax_compat import shard_map
+        fn = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    closed = jax.make_jaxpr(fn)(*args)
+    observed: set = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        if eqn.primitive.name not in _REDUCE_PRIMS:
+            continue
+        axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        observed.update(a for a in axes if isinstance(a, str))
+    findings: List[Finding] = []
+    detail = {"observed_axes": sorted(observed),
+              "declared": {"tp_axis": tp_axis, "ep_axis": ep_axis}}
+    for role, axis in (("tp_axis", tp_axis), ("ep_axis", ep_axis)):
+        if axis is not None and axis not in observed:
+            what = ("O-projection/MLP rejoin" if role == "tp_axis"
+                    else "expert-combine rejoin")
+            findings.append(Finding(
+                "GC-J106",
+                f"{label}: declared {role}={axis!r} but the decode step "
+                f"contains no psum over it — the {what} is missing, so "
+                f"every shard keeps its partial activations and the "
+                f"served logits are garbage (check the axis reached the "
+                f"model's decode_step)",
+                source="jaxpr_lint", detail=detail))
+    declared = {a for a in (tp_axis, ep_axis) if a is not None}
+    extra = observed - declared
+    if extra:
+        findings.append(Finding(
+            "GC-J106",
+            f"{label}: the decode step runs reduction collectives over "
+            f"{sorted(extra)} that the engine's config does not declare — "
+            f"per-token latency and per-device memory derived from the "
+            f"config are wrong for this program",
+            source="jaxpr_lint", detail=detail))
+    return findings
+
+
+def lint_decode_step(engine, *, name: Optional[str] = None,
+                     ignore: Sequence[str] = ()) -> List[Finding]:
+    """GC-J106 for a live :class:`~sparkflow_tpu.serving.decode.DecodeEngine`:
+    trace its steady-state decode step exactly as warmup compiles it (same
+    shard_map wrapper and specs when model-parallel) and check the observed
+    collectives against the tp/ep axes the engine declares. Zero findings is
+    the repo gate; both planted-defect directions live in
+    ``tests/test_decode.py``."""
+    import jax.numpy as jnp
+    B, maxp = engine.num_slots, engine.max_pages_per_slot
+    i32 = jnp.int32
+    args = (engine._param_struct(), engine._pool_struct(),
+            engine._pool_struct(),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B, maxp), i32),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((B,), jnp.float32),
+            jax.ShapeDtypeStruct((B,), i32))
+    mesh = in_specs = out_specs = None
+    if getattr(engine, "_sharded", False):
+        psp, pls, R = engine._param_specs, engine._pool_spec, P()
+        mesh = engine.mesh
+        in_specs = (psp, pls, pls, R, R, R, R, R, R)
+        out_specs = (R, pls, pls, R)
+    return lint_decode_collectives(
+        engine._decode_fn, args, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs, tp_axis=engine._tp_axis,
+        ep_axis=engine._ep_axis,
+        name=name or f"decode_step[tp={engine._tp},ep={engine._ep}]",
+        ignore=ignore)
 
 
 def lint_dp_train_step(model, optimizer="adam", *, mesh, sharding,

@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from .base import _Names
 from .registry import register_model
-from .transformer import _TransformerBase, _dense, _layer_norm
+from .transformer import TransformerLM, _layer_norm
 
 
 class _MoEMixin:
@@ -114,12 +114,29 @@ class _MoEMixin:
                 bp["experts_fc2"], bp["experts_b2"], self.ep_axis,
                 self.num_experts, self.capacity_factor, token_mask,
                 top_k=self.router_top_k)
+        return self._moe_mlp_slots(bp, x, token_mask)
+
+    def _moe_mlp_slots(self, bp, x, token_mask=None, ep_axis=None):
+        """Slot-table dispatch body of :meth:`_moe_mlp`. With ``ep_axis``
+        (decode-plane expert parallelism inside a ``shard_map``, batch
+        *replicated* — unlike ``all_to_all_moe_ffn``'s batch-sharded form):
+        every shard computes the identical global routing from the replicated
+        router, then dispatches only the tokens routed to its *local* expert
+        bank (``bp['experts_*']`` leading dim is ``E/ep``); each token's FFN
+        output lives on exactly one shard and the final ``psum`` rejoins the
+        replicated stream by summing one real row with exact zeros —
+        bit-identical to the unsharded dispatch."""
         b, s, h = x.shape
         e = self.num_experts
         k = self.router_top_k
         n = b * s
         c = self._capacity(n * k)
         xf = x.reshape(n, h)
+        if ep_axis is None:
+            e_loc, lo = e, 0
+        else:
+            e_loc = bp["experts_fc1"].shape[0]             # local bank E/ep
+            lo = jax.lax.axis_index(ep_axis) * e_loc
 
         router_logits = jnp.einsum("nh,he->ne", xf.astype(jnp.float32),
                                    bp["router"])
@@ -154,20 +171,23 @@ class _MoEMixin:
         stacked = jnp.concatenate(onehots, axis=0)               # [k*N, E]
         pos_all = jnp.cumsum(stacked, axis=0) - 1.0              # [k*N, E]
         xf_pad = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)], axis=0)
-        token_for_slot = jnp.full((e * c + 1,), n, dtype=jnp.int32)
+        token_for_slot = jnp.full((e_loc * c + 1,), n, dtype=jnp.int32)
         slots = []
         for ci in range(k):
             oh = onehots[ci]
             pos = jnp.sum(pos_all[ci * n:(ci + 1) * n] * oh,
                           axis=-1).astype(jnp.int32)             # [N]
-            kept = (pos < c) & (jnp.sum(oh, axis=-1) > 0)
-            slot = jnp.where(kept,
-                             top_idx[:, ci].astype(jnp.int32) * c + pos,
-                             e * c)
+            # slot positions come from the GLOBAL cumsum: capacity drops are
+            # decided identically on every shard, ownership only selects
+            # which shard serves the surviving (expert, slot) claims
+            loc = top_idx[:, ci].astype(jnp.int32) - lo
+            kept = ((pos < c) & (jnp.sum(oh, axis=-1) > 0)
+                    & (loc >= 0) & (loc < e_loc))
+            slot = jnp.where(kept, loc * c + pos, e_loc * c)
             token_for_slot = token_for_slot.at[slot].set(
                 jnp.arange(n, dtype=jnp.int32))
             slots.append(slot)
-        xe = xf_pad[token_for_slot[:e * c]].reshape(e, c, h)     # [E,C,H]
+        xe = xf_pad[token_for_slot[:e_loc * c]].reshape(e_loc, c, h)  # [E,C,H]
 
         # expert FFN over the slot buffers; leading axis sharded over 'ep'
         hmid = jnp.einsum("ech,ehm->ecm", xe, bp["experts_fc1"].astype(xe.dtype))
@@ -176,11 +196,14 @@ class _MoEMixin:
         out = out + bp["experts_b2"].astype(out.dtype)[:, None, :]
 
         # combine: each token reads its k slots back, weighted by its gates;
-        # overflow slot row is zero (dropped choices contribute nothing)
-        out_pad = jnp.concatenate([out.reshape(e * c, h),
+        # overflow slot row is zero (dropped AND non-local choices contribute
+        # nothing — under ep the psum supplies the owning shard's row)
+        out_pad = jnp.concatenate([out.reshape(e_loc * c, h),
                                    jnp.zeros((1, h), out.dtype)], axis=0)
         y = sum(out_pad[slots[ci]] * gates[:, ci:ci + 1].astype(out.dtype)
                 for ci in range(k))
+        if ep_axis is not None:
+            y = jax.lax.psum(y, ep_axis)
         return y.reshape(b, s, h).astype(x.dtype), aux
 
     def _block_aux(self, bp, x, mask, causal, train, rng):
@@ -202,10 +225,93 @@ class _MoEMixin:
         y, rng = self._dropout(y, train, rng)
         return x + y, rng, aux
 
+    # -- decode plane ---------------------------------------------------------
+    #
+    # The serving engine drives the same prefill/decode/verify entry points a
+    # dense TransformerLM exposes; MoE blocks override the three block-step
+    # forms to swap the dense FFN for the routed expert bank. The router aux
+    # loss is a training quantity — decode discards it. ``ep_axis`` selects
+    # the replicated-batch local-bank dispatch (``_moe_mlp_slots``), NOT the
+    # batch-sharded ``all_to_all_moe_ffn`` the training path uses.
+
+    def _block(self, bp, x, mask, causal, train, rng, with_kv: bool = False,
+               tp_axis=None, ep_axis=None):
+        if "router" not in bp:
+            return super()._block(bp, x, mask, causal, train, rng,
+                                  with_kv=with_kv, tp_axis=tp_axis,
+                                  ep_axis=ep_axis)
+        b, s, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = self._proj(bp, "qkv_", y)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, s, 3, heads, self.head_dim)
+        qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        att = self._attention(q, k, v, mask, causal)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, -1)
+        att, rng = self._dropout(self._proj(bp, "o_", att), train, rng)
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y, _ = self._moe_mlp_slots(bp, y, token_mask=mask, ep_axis=ep_axis)
+        y, rng = self._dropout(y, train, rng)
+        if with_kv:
+            return x + y, rng, k, v
+        return x + y, rng
+
+    def _block_decode(self, bp, x, layer, cache, pos, attend,
+                      tp_axis=None, ep_axis=None):
+        if "router" not in bp:
+            return super()._block_decode(bp, x, layer, cache, pos, attend,
+                                         tp_axis=tp_axis, ep_axis=ep_axis)
+        b, _, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = self._proj(bp, "qkv_", y)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, 3, heads, self.head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        att, cache = attend(layer, q, k, v, cache, pos)
+        att = self._proj(bp, "o_", att.reshape(b, 1, -1))
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y, _ = self._moe_mlp_slots(bp, y, ep_axis=ep_axis)
+        return x + y, cache
+
+    def _block_suffix(self, bp, x, layer, cache, start, attend,
+                      tp_axis=None, ep_axis=None):
+        if "router" not in bp:
+            return super()._block_suffix(bp, x, layer, cache, start, attend,
+                                         tp_axis=tp_axis, ep_axis=ep_axis)
+        b, s, h = x.shape
+        y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = self._proj(bp, "qkv_", y)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, s, 3, heads, self.head_dim)
+        qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        att, cache = attend(layer, q, k, v, cache, start)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, -1)
+        att = self._proj(bp, "o_", att)
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
+        x = x + att
+        y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        y, _ = self._moe_mlp_slots(bp, y, ep_axis=ep_axis)
+        return x + y, cache
+
 
 @register_model("transformer_moe_lm")
-class MoETransformerLM(_MoEMixin, _TransformerBase):
-    """Causal MoE LM: Switch FFN every ``moe_every``-th block, EP shardable."""
+class MoETransformerLM(_MoEMixin, TransformerLM):
+    """Causal MoE LM: Switch FFN every ``moe_every``-th block, EP shardable.
+
+    Deriving from :class:`TransformerLM` brings the full autoregressive
+    decode surface (``prefill``/``decode_step``/``decode_verify``/
+    ``prefill_suffix``) — the mixin's block overrides route MoE layers
+    through the expert bank, so the serving engine drives an MoE model
+    exactly like a dense one (expert-parallel over ``ep`` when configured)."""
 
     def __init__(self, vocab_size: int, num_experts: int = 8, moe_every: int = 2,
                  router_aux_weight: float = 0.01,

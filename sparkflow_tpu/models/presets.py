@@ -9,6 +9,7 @@ from typing import Sequence
 
 from .. import nn
 from ..graph_utils import build_graph
+from .registry import build_registry_spec
 
 
 def mlp(input_dim: int, num_classes: int, hidden: Sequence[int] = (256, 256),
@@ -63,3 +64,21 @@ def autoencoder(input_dim: int = 784,
         nn.mean_squared_error(recon, x)
 
     return build_graph(model)
+
+
+def moe_lm(vocab_size: int, *, hidden: int = 256, num_layers: int = 4,
+           num_heads: int = 8, mlp_dim: int = 1024, max_len: int = 512,
+           num_experts: int = 8, router_top_k: int = 2, moe_every: int = 2,
+           capacity_factor: float = 1.25, dropout: float = 0.0) -> str:
+    """Registry spec for a mixture-of-experts decoder LM sized for serving.
+
+    The defaults keep ``num_experts`` divisible across an ``('ep',)`` mesh
+    (expert-parallel decode, docs/serving.md) and ``num_heads`` divisible
+    across a ``('tp',)`` mesh, so the same spec serves replicated, tensor-
+    parallel, or expert-parallel without edits. Returns registry JSON for
+    ``model_from_json`` — NOT graph-DSL JSON like the builders above."""
+    return build_registry_spec(
+        "transformer_moe_lm", vocab_size=vocab_size, hidden=hidden,
+        num_layers=num_layers, num_heads=num_heads, mlp_dim=mlp_dim,
+        max_len=max_len, num_experts=num_experts, router_top_k=router_top_k,
+        moe_every=moe_every, capacity_factor=capacity_factor, dropout=dropout)

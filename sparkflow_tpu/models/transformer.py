@@ -158,67 +158,103 @@ class _TransformerBase(RegistryModel):
         # to the blockwise/reference paths inside flash_attention
         return flash_attention(q, k, v, causal=causal, kv_mask=mask)
 
-    def _block(self, bp, x, mask, causal, train, rng, with_kv: bool = False):
+    def _block(self, bp, x, mask, causal, train, rng, with_kv: bool = False,
+               tp_axis: Optional[str] = None, ep_axis: Optional[str] = None):
+        """``tp_axis``: inside a ``shard_map`` over that mesh axis, this block
+        runs megatron tensor-parallel — the qkv/fc1 projections see
+        column-sharded kernels (head count is derived from the *local* qkv
+        width, never ``self.num_heads``), o/fc2 see row shards producing
+        partial sums, and a single ``psum`` after each rejoins the replicated
+        residual stream. ``ep_axis`` is consumed by the MoE mixin's overrides;
+        dense blocks have no expert bank."""
+        del ep_axis
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = self._proj(bp, "qkv_", y)
-        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, s, 3, heads, self.head_dim)
         # ONE relayout for all three tensors ([B,S,3,h,d] -> [3,B,h,S,d]),
         # not three sliced transposes — TPU relayouts are real copies and
         # this is on the per-block hot path (same math, layout only)
         qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
         q, k, v = qkv[0], qkv[1], qkv[2]
         att = self._attention(q, k, v, mask, causal)
-        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, -1)
         att, rng = self._dropout(self._proj(bp, "o_", att), train, rng)
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         y = jax.nn.gelu(self._proj(bp, "fc1_", y))
         y, rng = self._dropout(self._proj(bp, "fc2_", y), train, rng)
+        if tp_axis is not None:
+            y = jax.lax.psum(y, tp_axis)
         if with_kv:
-            # prefill path: the block's keys/values ([B,heads,S,d]) feed the
-            # decode KV cache — same tensors attention just consumed
+            # prefill path: the block's keys/values ([B,heads,S,d], local
+            # heads under tp) feed the decode KV cache — same tensors
+            # attention just consumed
             return x + y, rng, k, v
         return x + y, rng
 
-    def _block_decode(self, bp, x, layer, cache, pos, attend):
+    def _block_decode(self, bp, x, layer, cache, pos, attend,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None):
         """One block applied to a single token ``x`` [B,1,hidden]; attention
         over the cached history is delegated to ``attend`` (see
         :meth:`TransformerLM.decode_step`). Same projections/norms/residuals
-        as :meth:`_block` — the architecture is defined once."""
+        as :meth:`_block` — the architecture is defined once. With
+        ``tp_axis`` set (inside a shard_map) the qkv projection yields the
+        shard's *local* heads, ``attend`` sees the matching heads-shard of
+        the KV cache, and one ``psum`` after the O-projection / after fc2
+        rejoins the replicated residual stream."""
+        del ep_axis
         b, _, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = self._proj(bp, "qkv_", y)
-        qkv = qkv.reshape(b, 3, self.num_heads, self.head_dim)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, 3, heads, self.head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, heads, d]
         att, cache = attend(layer, q, k, v, cache, pos)
-        att = self._proj(bp, "o_", att.reshape(b, 1, h))
+        att = self._proj(bp, "o_", att.reshape(b, 1, -1))
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         y = jax.nn.gelu(self._proj(bp, "fc1_", y))
         y = self._proj(bp, "fc2_", y)
+        if tp_axis is not None:
+            y = jax.lax.psum(y, tp_axis)
         return x + y, cache
 
-    def _block_suffix(self, bp, x, layer, cache, start, attend):
+    def _block_suffix(self, bp, x, layer, cache, start, attend,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None):
         """One block applied to a multi-token prompt *suffix* ``x``
         [B,S,hidden] whose first token sits at absolute position ``start``
         [B]; attention over (committed history ++ this chunk) is delegated to
         ``attend(layer, q, k_new, v_new, cache, start)`` with q/k/v
         ``[B, heads, S, d]``. Same projections/norms/residuals as
-        :meth:`_block` — the architecture is defined once."""
+        :meth:`_block` — the architecture is defined once. ``tp_axis``:
+        as in :meth:`_block_decode`."""
+        del ep_axis
         b, s, h = x.shape
         y = _layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = self._proj(bp, "qkv_", y)
-        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        heads = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(b, s, 3, heads, self.head_dim)
         qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))
         q, k, v = qkv[0], qkv[1], qkv[2]                   # [B, heads, S, d]
         att, cache = attend(layer, q, k, v, cache, start)
-        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, h)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, s, -1)
         att = self._proj(bp, "o_", att)
+        if tp_axis is not None:
+            att = jax.lax.psum(att, tp_axis)
         x = x + att
         y = _layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         y = jax.nn.gelu(self._proj(bp, "fc1_", y))
         y = self._proj(bp, "fc2_", y)
+        if tp_axis is not None:
+            y = jax.lax.psum(y, tp_axis)
         return x + y, cache
 
     def _block_aux(self, bp, x, mask, causal, train, rng):
@@ -359,7 +395,9 @@ class TransformerLM(_TransformerBase):
         return out.astype(q.dtype), cache
 
     def decode_step(self, params, cache, token, pos, attend=None,
-                    num_layers: Optional[int] = None):
+                    num_layers: Optional[int] = None,
+                    tp_axis: Optional[str] = None,
+                    ep_axis: Optional[str] = None):
         """Single-token autoregressive apply: embed ``token`` [B] int32 at
         position ``pos`` [B] int32, run every block over the cached history,
         return ``(logits [B, vocab] f32, cache)``.
@@ -373,7 +411,14 @@ class TransformerLM(_TransformerBase):
         usual final LN + tied-embedding head) — the self-speculation draft:
         the truncated model's layer-i K/V is *identical* to the full model's,
         so a draft pass can read and write the same paged pool the verify
-        pass uses, no separate draft cache or prefill needed."""
+        pass uses, no separate draft cache or prefill needed.
+
+        ``tp_axis``/``ep_axis``: mesh axes for tensor-/expert-parallel decode
+        inside a ``shard_map`` — params and cache arrive as per-shard slices,
+        activations stay replicated (see :meth:`_block_decode`). Note the
+        row-parallel biases (``o_bias``/``fc2_bias``) must be pre-divided by
+        the tp degree by the caller so the psum restores them exactly once
+        (serving/decode.py does this when placing params)."""
         if attend is None:
             attend = self._dense_cache_attend
         L = self.num_layers if num_layers is None else int(num_layers)
@@ -385,20 +430,24 @@ class TransformerLM(_TransformerBase):
         x = self.cast(x + posemb)[:, None, :]              # [B, 1, hidden]
         for i in range(L):
             x, cache = self._block_decode(params[f"block_{i}"], x, i, cache,
-                                          pos, attend)
+                                          pos, attend, tp_axis=tp_axis,
+                                          ep_axis=ep_axis)
         x = _layer_norm(x, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
         logits = jnp.matmul(x[:, 0].astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
         return logits, cache
 
-    def decode_verify(self, params, ids, start, cache, attend):
+    def decode_verify(self, params, ids, start, cache, attend,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None):
         """Speculative-verify forward: like :meth:`prefill_suffix` (``ids``
         [B,S] starting at absolute position ``start`` [B], attention over
         committed history + this chunk delegated to ``attend``) but projects
         logits at **every** position — ``(logits [B, S, vocab] f32, cache)``
         — so one call scores a drafted token block: ``logits[:, j]`` is the
-        target model's next-token distribution after prefix + drafts[:j]."""
+        target model's next-token distribution after prefix + drafts[:j].
+        ``tp_axis``/``ep_axis``: as in :meth:`decode_step`."""
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         start = start.astype(jnp.int32)
@@ -409,19 +458,24 @@ class TransformerLM(_TransformerBase):
         x = self.cast(x + posemb)
         for i in range(self.num_layers):
             x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
-                                          start, attend)
+                                          start, attend, tp_axis=tp_axis,
+                                          ep_axis=ep_axis)
         x = _layer_norm(x, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
         logits = jnp.matmul(x.astype(jnp.float32),
                             params["embed"]["tok"].T.astype(jnp.float32))
         return logits, cache
 
-    def prefill(self, params, ids, mask=None, lengths=None):
+    def prefill(self, params, ids, mask=None, lengths=None,
+                tp_axis: Optional[str] = None,
+                ep_axis: Optional[str] = None):
         """Causal forward over a (padded) prompt that also returns each
         block's keys/values for the decode cache: ``(logits [B, vocab] at
         the last valid position, [(k, v)] * layers with k/v [B,heads,S,d])``.
         ``lengths`` [B] selects the position whose logits seed generation
-        (default: the full row, ``S``)."""
+        (default: the full row, ``S``). ``tp_axis``/``ep_axis``: as in
+        :meth:`decode_step`; under tp the returned k/v carry the shard's
+        *local* heads — exactly the slice its heads-sharded pool stores."""
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         x = jnp.take(params["embed"]["tok"], ids, axis=0)
@@ -430,7 +484,8 @@ class TransformerLM(_TransformerBase):
         kvs = []
         for i in range(self.num_layers):
             x, rng, k, v = self._block(params[f"block_{i}"], x, mask, True,
-                                       False, rng, with_kv=True)
+                                       False, rng, with_kv=True,
+                                       tp_axis=tp_axis, ep_axis=ep_axis)
             kvs.append((k, v))
         x = _layer_norm(x, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
@@ -443,7 +498,9 @@ class TransformerLM(_TransformerBase):
                             params["embed"]["tok"].T.astype(jnp.float32))
         return logits, kvs
 
-    def prefill_suffix(self, params, ids, start, cache, attend, lengths=None):
+    def prefill_suffix(self, params, ids, start, cache, attend, lengths=None,
+                       tp_axis: Optional[str] = None,
+                       ep_axis: Optional[str] = None):
         """Prefill a prompt **suffix**: like :meth:`prefill` but the first
         token of ``ids`` [B,S] sits at absolute position ``start`` [B] int32
         (position embeddings offset accordingly) and attention over the
@@ -465,7 +522,8 @@ class TransformerLM(_TransformerBase):
         x = self.cast(x + posemb)
         for i in range(self.num_layers):
             x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
-                                          start, attend)
+                                          start, attend, tp_axis=tp_axis,
+                                          ep_axis=ep_axis)
         x = _layer_norm(x, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
         if lengths is None:

@@ -240,12 +240,16 @@ def gathered_param_view(p_local, like, axis_name: str = "dp"):
 def zero1_state_specs(state, n_shards: int, axis_name: str = "dp"):
     """PartitionSpec pytree for a zero1 state: ``[n_shards, ...]`` leaves
     shard row-wise over ``axis_name``, everything else replicates. Works on
-    arrays, tracers, or ShapeDtypeStructs."""
+    arrays, tracers, or ShapeDtypeStructs. The per-leaf rule is
+    :func:`~sparkflow_tpu.sharding.at_rest_leaf_spec` (``layout='flat'``) —
+    the same decision ``fsdp_pspecs`` applies to model-shape tensors,
+    expressed on the flat ``[n_shards, s]`` layout."""
+    from .sharding import at_rest_leaf_spec
+
     def spec(x):
         shape = getattr(x, "shape", ())
-        if len(shape) >= 2 and shape[0] == n_shards:
-            return P(axis_name)
-        return P()
+        return at_rest_leaf_spec(shape, axis_name, layout="flat",
+                                 n_shards=n_shards)
 
     return jax.tree.map(spec, state)
 

@@ -13,10 +13,10 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import _step_body, make_loss_fn
+from ..sharding import at_rest_leaf_spec
 
 
 def filter_pspec(spec: P, mesh: Mesh) -> P:
@@ -44,20 +44,16 @@ def shard_params(params, mesh: Mesh, pspecs):
 
 def fsdp_pspecs(param_specs, axis: str = "fsdp", min_size: int = 2 ** 16):
     """ZeRO-style specs from a model's ``param_specs()``: big tensors shard
-    their largest dim over ``axis``; small ones replicate."""
-    out = {}
-    for lname, pspec in param_specs.items():
-        layer = {}
-        for pname, (shape, _init) in pspec.items():
-            if int(np.prod(shape)) >= min_size and len(shape) >= 1:
-                big = int(np.argmax(shape))
-                spec = [None] * len(shape)
-                spec[big] = axis
-                layer[pname] = P(*spec)
-            else:
-                layer[pname] = P()
-        out[lname] = layer
-    return out
+    their largest dim over ``axis``; small ones replicate. The per-leaf rule
+    is :func:`~sparkflow_tpu.sharding.at_rest_leaf_spec` (``layout='gspmd'``)
+    — the SAME decision the flat ZeRO-3 layout applies to its ``[n, s]``
+    leaves, expressed on tensors kept in model shape."""
+    return {
+        lname: {
+            pname: at_rest_leaf_spec(shape, axis, layout="gspmd",
+                                     min_size=min_size)
+            for pname, (shape, _init) in pspec.items()}
+        for lname, pspec in param_specs.items()}
 
 
 def make_sharded_train_step(model, optimizer, mesh: Mesh, input_name: str,
@@ -81,19 +77,93 @@ def make_sharded_train_step(model, optimizer, mesh: Mesh, input_name: str,
                    donate_argnums=(0, 1))
 
 
-def derive_param_pspecs(model, mesh: Mesh):
-    """Parameter PartitionSpecs for training ``model`` on ``mesh``.
+def tp_pack_params(model, params, tp: int):
+    """Host-side relayout of a transformer params tree for **shard_map**
+    tensor parallelism (the decode plane's form — GSPMD jit needs none of
+    this, sharding there is metadata only).
 
-    - mesh has ``tp``/``ep`` and the model publishes megatron-style rules
-      (``param_pspecs``, transformer/resnet/moe families) -> those rules
-      (axes absent from the mesh degrade to replication via
+    Under shard_map each rank sees a contiguous column block of
+    ``qkv_kernel``, but the kernel packs its output as ``(3, heads, d)``
+    flattened — a naive block mixes q/k/v rows of unrelated heads. Permuting
+    columns to ``(tp, 3, heads/tp, d)`` order makes rank r's block exactly
+    ``[q_r | k_r | v_r]``, so the block-local ``reshape(b, 3, H/tp, d)``
+    recovers its own heads (``qkv_bias`` permutes identically). The
+    row-parallel biases (``o_bias``, ``fc2_bias``) divide by ``tp`` so the
+    decode-step psum restores them exactly once — exact in floating point
+    for power-of-two ``tp``. Expert banks / router / norms / embeddings pass
+    through untouched (experts shard whole-expert over ``ep``; everything
+    else is replicated or column-natural)."""
+    if tp <= 1:
+        return params
+    import jax.numpy as jnp
+    H, d = int(model.num_heads), int(model.head_dim)
+    if H % tp:
+        raise ValueError(f"num_heads={H} is not divisible by tp={tp}")
+    perm = jnp.transpose(jnp.arange(3 * H * d).reshape(3, tp, H // tp, d),
+                         (1, 0, 2, 3)).reshape(-1)
+
+    def pack_block(bp):
+        if any(k.endswith("kernel_q8") for k in bp):
+            raise ValueError(
+                "tensor-parallel serving does not compose with int8-"
+                "quantized params; quantize or shard the model, not both")
+        bp = dict(bp)
+        bp["qkv_kernel"] = jnp.asarray(bp["qkv_kernel"])[:, perm]
+        if "qkv_bias" in bp:
+            bp["qkv_bias"] = jnp.asarray(bp["qkv_bias"])[perm]
+        if "o_bias" in bp:
+            bp["o_bias"] = jnp.asarray(bp["o_bias"]) / tp
+        if "fc2_bias" in bp:
+            bp["fc2_bias"] = jnp.asarray(bp["fc2_bias"]) / tp
+        return bp
+
+    return {name: (pack_block(sub) if isinstance(sub, dict)
+                   and "qkv_kernel" in sub else sub)
+            for name, sub in params.items()}
+
+
+def rename_pspec_axes(pspecs, mapping: dict):
+    """Rename axis names inside a PartitionSpec pytree — e.g. the megatron
+    rules' literal ``'tp'``/``'ep'`` onto a ShardingConfig's configured
+    ``tp_axis``/``ep_axis``. Axes not in ``mapping`` pass through."""
+    def rename_entry(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            return tuple(mapping.get(x, x) for x in a)
+        return mapping.get(a, a)
+
+    return jax.tree.map(
+        lambda s: P(*(rename_entry(a) for a in s)),
+        pspecs, is_leaf=lambda x: isinstance(x, P))
+
+
+def derive_param_pspecs(model, mesh: Mesh, sharding=None):
+    """Parameter PartitionSpecs for running ``model`` on ``mesh``.
+
+    - mesh has ``tp``/``ep`` (or the axes a ``sharding`` config names for
+      them) and the model publishes megatron-style rules (``param_pspecs``,
+      transformer/resnet/moe families) -> those rules, renamed to the
+      configured axes (axes absent from the mesh degrade to replication via
       :func:`filter_pspec` inside :func:`shard_params`);
     - mesh has ``fsdp`` -> ZeRO-style :func:`fsdp_pspecs` derived from the
       model's ``param_specs()`` — works for ANY model incl. the ``nn``-DSL
       graphs (largest dim of every big tensor shards, small ones replicate);
     - otherwise (pure dp) -> ``None``: replicate params, shard the batch.
+
+    Both branches derive from ONE per-leaf decision
+    (:func:`~sparkflow_tpu.sharding.at_rest_leaf_spec` for the at-rest
+    layouts; the model's own megatron table for compute sharding) — this is
+    the single spec-derivation entry point the trainer AND the serving
+    engines call.
     """
-    has_tp = any(a in mesh.axis_names for a in ("tp", "ep"))
+    names = {"tp": "tp", "ep": "ep"}
+    if sharding is not None:
+        if getattr(sharding, "tp_axis", None):
+            names["tp"] = sharding.tp_axis
+        if getattr(sharding, "ep_axis", None):
+            names["ep"] = sharding.ep_axis
+    has_tp = any(a in mesh.axis_names for a in (names["tp"], names["ep"]))
     has_fsdp = "fsdp" in mesh.axis_names
     if has_tp and has_fsdp:
         # auto-composing megatron rules WITH ZeRO sharding needs per-tensor
@@ -104,7 +174,11 @@ def derive_param_pspecs(model, mesh: Mesh):
             "explicit PartitionSpec pytree (Trainer(param_sharding=...)) or "
             "drop one of the axes")
     if has_tp and hasattr(model, "param_pspecs"):
-        return model.param_pspecs()
+        specs = model.param_pspecs()
+        if names["tp"] != "tp" or names["ep"] != "ep":
+            specs = rename_pspec_axes(specs, {"tp": names["tp"],
+                                              "ep": names["ep"]})
+        return specs
     if has_fsdp and hasattr(model, "param_specs"):
         return fsdp_pspecs(model.param_specs(), axis="fsdp")
     return None

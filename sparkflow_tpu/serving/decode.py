@@ -87,12 +87,14 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..analysis.runtime_guards import RecompileGuard
 from ..obs.spans import span as obs_span
 from ..ops import paged_attention, paged_attention_verify
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
+from ..sharding import per_device_bytes
 from .kvcache import OutOfPages, PagedKVCache
 
 __all__ = ["DecodeEngine"]
@@ -155,6 +157,21 @@ class DecodeEngine:
         A separately trained small causal LM (same vocab) used as the draft
         instead of self-speculation; it keeps its own dense KV cache and
         prefills at admission through its own AOT ladder.
+    mesh : jax.sharding.Mesh | None
+        Serving mesh for model-parallel decode. With a ``sharding`` config
+        naming ``tp_axis`` / ``ep_axis`` present on this mesh, every
+        decode-plane executable becomes a shard_map over those axes:
+        attention/MLP weights and the KV pool's heads axis shard over tp
+        (each shard runs the unmodified pallas kernels on its own head
+        slice, one psum after the O-projection / MLP rejoins activations),
+        expert banks shard over ep. Greedy output is token-identical to the
+        unsharded engine; an external ``draft_model`` stays replicated off
+        the mesh.
+    sharding : ShardingConfig | dict | str | None
+        Declarative axis naming (see :mod:`sparkflow_tpu.sharding`). Only
+        ``tp_axis`` / ``ep_axis`` are consulted here; axes absent from the
+        mesh (or of size 1) deactivate, so one config serves both sharded
+        and single-device deployments.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -165,6 +182,7 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  spec_k: int = 0, draft_layers: Optional[int] = None,
                  draft_model=None, draft_params=None,
+                 mesh=None, sharding=None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -174,6 +192,45 @@ class DecodeEngine:
                 raise TypeError(f"model has no {need}(); DecodeEngine needs "
                                 f"a causal LM (transformer_lm)")
         self.model = model
+        # model-parallel serving: a ShardingConfig naming tp_axis/ep_axis on
+        # a mesh turns every decode-plane executable into a shard_map over
+        # those axes — attention/MLP weights and the KV pool's heads axis
+        # shard over tp, expert banks over ep, activations stay replicated.
+        # tp * ep == 1 keeps the exact single-device program (no wrapper).
+        self.mesh = mesh
+        self.sharding = None
+        self._tp_axis: Optional[str] = None
+        self._ep_axis: Optional[str] = None
+        self._tp = 1
+        self._ep = 1
+        if sharding is not None:
+            from ..sharding import as_sharding_config
+            self.sharding = as_sharding_config(sharding)
+            if mesh is None and self.sharding.model_parallel():
+                raise ValueError("sharding names tp_axis/ep_axis but no mesh "
+                                 "was given; pass mesh= to DecodeEngine")
+        if self.mesh is not None and self.sharding is not None:
+            self.sharding.validate(self.mesh, require_data_axis=False)
+            tp_ax, ep_ax = self.sharding.tp_axis, self.sharding.ep_axis
+            if tp_ax and int(self.mesh.shape[tp_ax]) > 1:
+                self._tp_axis, self._tp = tp_ax, int(self.mesh.shape[tp_ax])
+            if ep_ax and int(self.mesh.shape[ep_ax]) > 1:
+                self._ep_axis, self._ep = ep_ax, int(self.mesh.shape[ep_ax])
+        self._sharded = self._tp * self._ep > 1
+        if self._tp > 1 and int(model.num_heads) % self._tp:
+            raise ValueError(f"num_heads={model.num_heads} is not divisible "
+                             f"by tp={self._tp}")
+        if self._ep > 1:
+            n_exp = getattr(model, "num_experts", None)
+            if not n_exp:
+                raise ValueError("ep_axis is set but the model has no expert "
+                                 "bank (num_experts); use a transformer_moe_lm")
+            if int(n_exp) % self._ep:
+                raise ValueError(f"num_experts={n_exp} is not divisible by "
+                                 f"ep={self._ep}")
+        if self._sharded and not hasattr(model, "param_pspecs"):
+            raise TypeError("model-parallel decode needs the model to "
+                            "publish param_pspecs() (megatron rules)")
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -249,13 +306,38 @@ class DecodeEngine:
         if isinstance(params, (list, tuple)):
             from ..graphdef import list_to_params
             params = list_to_params(model, list(params))
+        self._param_specs = None
+        if self._sharded:
+            from ..parallel.tp import (derive_param_pspecs, filter_pspec,
+                                       shard_params, tp_pack_params)
+            if self._tp > 1:
+                # shard_map hands each rank a contiguous column block: permute
+                # qkv columns to (tp, 3, H/tp, d) order and pre-divide the
+                # row-parallel biases so the decode psums are exact
+                params = tp_pack_params(model, params, self._tp)
+            pspecs = derive_param_pspecs(model, self.mesh, self.sharding)
+            self._param_specs = jax.tree.map(
+                lambda s: filter_pspec(s, self.mesh), pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            params = shard_params(params, self.mesh, self._param_specs)
         self._params = params
         pool_dtype = (model.compute_dtype if model.compute_dtype is not None
                       else jnp.float32)
+        # GLOBAL pool shape; under tp the heads axis shards across the mesh
+        # ([layers, pages, page, heads/tp, d] per device), which leaves the
+        # pallas kernels' slot/page grids untouched — each shard runs the
+        # unmodified kernel over its own head slice
         pool_shape = (model.num_layers, num_pages, self.page_size,
                       model.num_heads, model.head_dim)
-        self._k_pool = jnp.zeros(pool_shape, pool_dtype)
-        self._v_pool = jnp.zeros(pool_shape, pool_dtype)
+        self._pool_spec = (P(None, None, None, self._tp_axis, None)
+                           if self._tp_axis else P())
+        if self._sharded:
+            ns = NamedSharding(self.mesh, self._pool_spec)
+            self._k_pool = jax.device_put(jnp.zeros(pool_shape, pool_dtype), ns)
+            self._v_pool = jax.device_put(jnp.zeros(pool_shape, pool_dtype), ns)
+        else:
+            self._k_pool = jnp.zeros(pool_shape, pool_dtype)
+            self._v_pool = jnp.zeros(pool_shape, pool_dtype)
         if self._draft_model is not None:
             dm = self._draft_model
             # dense per-slot draft cache: positions can reach
@@ -269,8 +351,11 @@ class DecodeEngine:
                    else jnp.float32)
             self._draft_k = jnp.zeros(dshape, ddt)
             self._draft_v = jnp.zeros(dshape, ddt)
-        self._keys = jnp.stack([jax.random.PRNGKey(seed + i)
-                                for i in range(self.num_slots)])
+        # host-side key state: per-slot mutation is numpy indexing, and an
+        # uncommitted host array places cleanly on whatever sharding each
+        # executable expects (single-device and mesh executables coexist)
+        self._keys = np.stack([np.asarray(jax.random.PRNGKey(seed + i))
+                               for i in range(self.num_slots)])
         self._last_token = np.zeros(self.num_slots, np.int32)
         self._temp = np.zeros(self.num_slots, np.float32)
         self._topk = np.zeros(self.num_slots, np.int32)
@@ -348,7 +433,8 @@ class DecodeEngine:
             return out.astype(q.dtype), (kp, vp)
 
         logits, (k_pool, v_pool) = self.model.decode_step(
-            params, (k_pool, v_pool), token, pos, attend=attend)
+            params, (k_pool, v_pool), token, pos, attend=attend,
+            tp_axis=self._tp_axis, ep_axis=self._ep_axis)
         tok, keys = self._sample_tokens(logits, keys, temp, topk)
         return tok, k_pool, v_pool, keys
 
@@ -360,13 +446,17 @@ class DecodeEngine:
             # causal attention makes valid rows independent of the padded
             # tail, so no kv_mask is needed; the padded tail's K/V lands in
             # positions >= length, which decode attention masks by length
-            logits, kvs = model.prefill(params, ids, lengths=length)
+            logits, kvs = model.prefill(params, ids, lengths=length,
+                                        tp_axis=self._tp_axis,
+                                        ep_axis=self._ep_axis)
             for i, (k, v) in enumerate(kvs):
-                # [1, heads, bucket, d] -> [npages, page, heads, d]
+                # [1, heads, bucket, d] -> [npages, page, heads, d]; the
+                # head count comes from the tensor (the shard's LOCAL heads
+                # under tp — matching its heads-slice of the pool)
                 kk = jnp.transpose(k[0], (1, 0, 2)).reshape(
-                    npages, page, model.num_heads, model.head_dim)
+                    npages, page, k.shape[1], k.shape[3])
                 vv = jnp.transpose(v[0], (1, 0, 2)).reshape(
-                    npages, page, model.num_heads, model.head_dim)
+                    npages, page, v.shape[1], v.shape[3])
                 k_pool = k_pool.at[i, page_ids].set(kk.astype(k_pool.dtype))
                 v_pool = v_pool.at[i, page_ids].set(vv.astype(v_pool.dtype))
             return logits, k_pool, v_pool
@@ -382,14 +472,14 @@ class DecodeEngine:
         per-slot events, the decode hot path stays the pallas kernel."""
         model, page, C = self.model, self.page_size, self._chunk_width
         maxp = self.max_pages_per_slot
-        heads, hd = model.num_heads, model.head_dim
-        scale = 1.0 / math.sqrt(hd)
+        scale = 1.0 / math.sqrt(model.head_dim)
         j = jnp.arange(C, dtype=jnp.int32)
         tpos = jnp.arange(maxp * page, dtype=jnp.int32)
 
         def suffix_prefill(params, k_pool, v_pool, ids, start, valid, ctable):
             def attend(layer, q, k_new, v_new, cache, st):
                 kp, vp = cache
+                heads, hd = kp.shape[-2], kp.shape[-1]         # local under tp
                 pos_abs = st[0] + j                            # [C] absolute
                 pids = ctable[jnp.clip(pos_abs // page, 0, maxp - 1)]
                 pids = jnp.where(j < valid[0], pids, 0)        # pad -> scratch
@@ -411,7 +501,8 @@ class DecodeEngine:
                 return out[None].astype(q.dtype), (kp, vp)
 
             logits, (k_pool, v_pool) = model.prefill_suffix(
-                params, ids, start, (k_pool, v_pool), attend, lengths=valid)
+                params, ids, start, (k_pool, v_pool), attend, lengths=valid,
+                tp_axis=self._tp_axis, ep_axis=self._ep_axis)
             return logits, k_pool, v_pool
 
         return suffix_prefill
@@ -462,7 +553,8 @@ class DecodeEngine:
             for j in range(K):
                 logits, (k_pool, v_pool) = model.decode_step(
                     params, (k_pool, v_pool), tok, pos + j, attend=attend,
-                    num_layers=Ld)
+                    num_layers=Ld, tp_axis=self._tp_axis,
+                    ep_axis=self._ep_axis)
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 toks.append(tok)
             return jnp.stack(toks, axis=1), k_pool, v_pool
@@ -556,7 +648,8 @@ class DecodeEngine:
                 return out.astype(q.dtype), (kp, vp)
 
             logits, (k_pool, v_pool) = model.decode_verify(
-                params, ids, start, (k_pool, v_pool), attend)
+                params, ids, start, (k_pool, v_pool), attend,
+                tp_axis=self._tp_axis, ep_axis=self._ep_axis)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
             samp0, keys = self._sample_tokens(logits[:, 0], keys, temp, topk)
             return g, samp0, k_pool, v_pool, keys
@@ -582,6 +675,26 @@ class DecodeEngine:
     def _pool_struct(self):
         return jax.ShapeDtypeStruct(self._k_pool.shape, self._k_pool.dtype)
 
+    def _aot(self, fn, donate, arg_structs, specs=None, out_specs=None):
+        """jit -> lower -> compile one decode-plane executable. With model
+        parallelism on (and ``specs`` given), the body wraps in a shard_map
+        over the serving mesh — pallas custom calls have no GSPMD
+        partitioning rule, so every executable is explicitly per-shard with
+        replicated activations — and the inputs carry matching
+        NamedShardings. ``tp * ep == 1`` compiles the exact unwrapped
+        program."""
+        guard = self.recompile_guard
+        if not (self._sharded and specs is not None):
+            return jax.jit(guard.wrap(fn), donate_argnums=donate).lower(
+                *arg_structs).compile()
+        from ..jax_compat import shard_map
+        body = shard_map(fn, mesh=self.mesh, in_specs=specs,
+                         out_specs=out_specs, check_vma=False)
+        in_sh = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                             specs, is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(guard.wrap(body), in_shardings=in_sh,
+                       donate_argnums=donate).lower(*arg_structs).compile()
+
     def warmup(self) -> None:
         """AOT-compile the decode step, the prefill-sampling helper, and
         every prefill bucket, then pin steady state: any later trace is a
@@ -595,41 +708,45 @@ class DecodeEngine:
         pool = self._pool_struct()
         B, maxp = self.num_slots, self.max_pages_per_slot
         i32 = jnp.int32
+        psp, pls, R = self._param_specs, self._pool_spec, P()
         if self._decode_exe is None:
             with annotate("serving/decode_compile_step"):
-                self._decode_exe = jax.jit(
-                    guard.wrap(self._decode_fn),
-                    donate_argnums=(1, 2)).lower(
-                        ps, pool, pool,
-                        jax.ShapeDtypeStruct((B,), i32),
-                        jax.ShapeDtypeStruct((B,), i32),
-                        jax.ShapeDtypeStruct((B, maxp), i32),
-                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
-                        jax.ShapeDtypeStruct((B,), jnp.float32),
-                        jax.ShapeDtypeStruct((B,), i32)).compile()
+                self._decode_exe = self._aot(
+                    self._decode_fn, (1, 2),
+                    (ps, pool, pool,
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B, maxp), i32),
+                     jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                     jax.ShapeDtypeStruct((B,), jnp.float32),
+                     jax.ShapeDtypeStruct((B,), i32)),
+                    specs=(psp, pls, pls, R, R, R, R, R, R),
+                    out_specs=(R, pls, pls, R))
             self.aot_compiles += 1
         if self._sample_exe is None:
             with annotate("serving/decode_compile_sample"):
-                self._sample_exe = jax.jit(guard.wrap(
-                    self._sample_tokens)).lower(
-                        jax.ShapeDtypeStruct((1, self.model.vocab_size),
-                                             jnp.float32),
-                        jax.ShapeDtypeStruct((1, 2), jnp.uint32),
-                        jax.ShapeDtypeStruct((1,), jnp.float32),
-                        jax.ShapeDtypeStruct((1,), i32)).compile()
+                self._sample_exe = self._aot(
+                    self._sample_tokens, (),
+                    (jax.ShapeDtypeStruct((1, self.model.vocab_size),
+                                          jnp.float32),
+                     jax.ShapeDtypeStruct((1, 2), jnp.uint32),
+                     jax.ShapeDtypeStruct((1,), jnp.float32),
+                     jax.ShapeDtypeStruct((1,), i32)),
+                    specs=(R, R, R, R),
+                    out_specs=(R, R))
             self.aot_compiles += 1
         for b in self.prefill_buckets:
             if b in self._prefill_exes:
                 continue
             with annotate(f"serving/decode_compile_prefill_b{b}"):
-                self._prefill_exes[b] = jax.jit(
-                    guard.wrap(self._prefill_fn(b)),
-                    donate_argnums=(1, 2)).lower(
-                        ps, pool, pool,
-                        jax.ShapeDtypeStruct((1, b), i32),
-                        jax.ShapeDtypeStruct((1,), i32),
-                        jax.ShapeDtypeStruct((b // self.page_size,),
-                                             i32)).compile()
+                self._prefill_exes[b] = self._aot(
+                    self._prefill_fn(b), (1, 2),
+                    (ps, pool, pool,
+                     jax.ShapeDtypeStruct((1, b), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((b // self.page_size,), i32)),
+                    specs=(psp, pls, pls, R, R, R),
+                    out_specs=(R, pls, pls))
             self.aot_compiles += 1
         C = self._chunk_width
         chunk_structs = (
@@ -639,23 +756,25 @@ class DecodeEngine:
             jax.ShapeDtypeStruct((maxp,), i32))      # slot's table row
         if self._suffix_exe is None:
             with annotate("serving/decode_compile_suffix"):
-                self._suffix_exe = jax.jit(
-                    guard.wrap(self._suffix_fn()),
-                    donate_argnums=(1, 2)).lower(
-                        ps, pool, pool, *chunk_structs).compile()
+                self._suffix_exe = self._aot(
+                    self._suffix_fn(), (1, 2),
+                    (ps, pool, pool, *chunk_structs),
+                    specs=(psp, pls, pls, R, R, R, R),
+                    out_specs=(R, pls, pls))
             self.aot_compiles += 1
         if self.prefill_chunk and self._fused_exe is None:
             with annotate("serving/decode_compile_fused"):
-                self._fused_exe = jax.jit(
-                    guard.wrap(self._fused_fn()),
-                    donate_argnums=(1, 2)).lower(
-                        ps, pool, pool, *chunk_structs,
-                        jax.ShapeDtypeStruct((B,), i32),
-                        jax.ShapeDtypeStruct((B,), i32),
-                        jax.ShapeDtypeStruct((B, maxp), i32),
-                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
-                        jax.ShapeDtypeStruct((B,), jnp.float32),
-                        jax.ShapeDtypeStruct((B,), i32)).compile()
+                self._fused_exe = self._aot(
+                    self._fused_fn(), (1, 2),
+                    (ps, pool, pool, *chunk_structs,
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B, maxp), i32),
+                     jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                     jax.ShapeDtypeStruct((B,), jnp.float32),
+                     jax.ShapeDtypeStruct((B,), i32)),
+                    specs=(psp, pls, pls, R, R, R, R, R, R, R, R, R, R),
+                    out_specs=(R, R, pls, pls, R))
             self.aot_compiles += 1
         if self.spec_k:
             self._warmup_spec_locked(ps, pool, B, maxp)
@@ -665,41 +784,44 @@ class DecodeEngine:
         guard = self.recompile_guard
         i32 = jnp.int32
         S = self.spec_k + 1
+        psp, pls, R = self._param_specs, self._pool_spec, P()
         if self._verify_exe is None:
             with annotate("serving/decode_compile_verify"):
-                self._verify_exe = jax.jit(
-                    guard.wrap(self._verify_fn()),
-                    donate_argnums=(1, 2)).lower(
-                        ps, pool, pool,
-                        jax.ShapeDtypeStruct((B, S), i32),      # chunk ids
-                        jax.ShapeDtypeStruct((B,), i32),        # start
-                        jax.ShapeDtypeStruct((B,), i32),        # nvalid
-                        jax.ShapeDtypeStruct((B, maxp), i32),
-                        jax.ShapeDtypeStruct((B, 2), jnp.uint32),
-                        jax.ShapeDtypeStruct((B,), jnp.float32),
-                        jax.ShapeDtypeStruct((B,), i32)).compile()
+                self._verify_exe = self._aot(
+                    self._verify_fn(), (1, 2),
+                    (ps, pool, pool,
+                     jax.ShapeDtypeStruct((B, S), i32),      # chunk ids
+                     jax.ShapeDtypeStruct((B,), i32),        # start
+                     jax.ShapeDtypeStruct((B,), i32),        # nvalid
+                     jax.ShapeDtypeStruct((B, maxp), i32),
+                     jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                     jax.ShapeDtypeStruct((B,), jnp.float32),
+                     jax.ShapeDtypeStruct((B,), i32)),
+                    specs=(psp, pls, pls, R, R, R, R, R, R, R),
+                    out_specs=(R, R, pls, pls, R))
             self.aot_compiles += 1
         if self._copy_exe is None:
             with annotate("serving/decode_compile_copy"):
-                self._copy_exe = jax.jit(
-                    guard.wrap(self._copy_pages_fn),
-                    donate_argnums=(0, 1)).lower(
-                        pool, pool,
-                        jax.ShapeDtypeStruct((), i32),
-                        jax.ShapeDtypeStruct((), i32)).compile()
+                self._copy_exe = self._aot(
+                    self._copy_pages_fn, (0, 1),
+                    (pool, pool,
+                     jax.ShapeDtypeStruct((), i32),
+                     jax.ShapeDtypeStruct((), i32)),
+                    specs=(pls, pls, R, R),
+                    out_specs=(pls, pls))
             self.aot_compiles += 1
         if self._draft_model is None:
             if self._draft_exe is None:
                 with annotate("serving/decode_compile_draft"):
-                    self._draft_exe = jax.jit(
-                        guard.wrap(self._self_draft_fn()),
-                        donate_argnums=(1, 2)).lower(
-                            ps, pool, pool,
-                            jax.ShapeDtypeStruct((B,), i32),    # token
-                            jax.ShapeDtypeStruct((B,), i32),    # pos
-                            jax.ShapeDtypeStruct((B, maxp), i32),
-                            jax.ShapeDtypeStruct((B,), i32)     # nappend
-                            ).compile()
+                    self._draft_exe = self._aot(
+                        self._self_draft_fn(), (1, 2),
+                        (ps, pool, pool,
+                         jax.ShapeDtypeStruct((B,), i32),    # token
+                         jax.ShapeDtypeStruct((B,), i32),    # pos
+                         jax.ShapeDtypeStruct((B, maxp), i32),
+                         jax.ShapeDtypeStruct((B,), i32)),   # nappend
+                        specs=(psp, pls, pls, R, R, R, R),
+                        out_specs=(R, pls, pls))
                 self.aot_compiles += 1
             return
         dps = jax.tree.map(
@@ -785,8 +907,7 @@ class DecodeEngine:
             self._topk[slot] = min(int(top_k), self.max_top_k)
             self._decode_ready[slot] = False
             if seed is not None:
-                self._keys = self._keys.at[slot].set(
-                    jax.random.PRNGKey(int(seed)))
+                self._keys[slot] = np.asarray(jax.random.PRNGKey(int(seed)))
             self._prefills += 1
             self.metrics.observe("serving/decode/prompt_tokens", n)
             if self.prefill_chunk is not None and n - start > self.prefill_chunk:
@@ -824,7 +945,7 @@ class DecodeEngine:
                 np.asarray(logits), self._keys[slot][None],
                 np.asarray([temperature], np.float32),
                 np.asarray([min(int(top_k), self.max_top_k)], np.int32))
-            self._keys = self._keys.at[slot].set(key[0])
+            self._keys[slot] = np.asarray(key)[0]
             first = int(np.asarray(tok)[0])
             self._last_token[slot] = first
             self._decode_ready[slot] = True
@@ -919,13 +1040,14 @@ class DecodeEngine:
                               args={"active": int(ready.size),
                                     "slot": state["slot"]},
                               jax_annotation=True):
-                    logits, tok, self._k_pool, self._v_pool, self._keys = \
+                    logits, tok, self._k_pool, self._v_pool, keys = \
                         self._fused_exe(
                             self._params, self._k_pool, self._v_pool, ids,
                             np.asarray([p], np.int32),
                             np.asarray([c], np.int32),
                             table_full[state["slot"]], token, pos, table,
                             self._keys, self._temp, self._topk)
+                self._keys = np.array(keys)
                 state["next"] = p + c
                 if state["next"] >= end:  # final chunk: first token is born
                     self._pending.pop(0)
@@ -937,13 +1059,13 @@ class DecodeEngine:
                     if state["seed"] is not None:
                         # the fused steps advanced every lane's key; re-pin
                         # the requested seed before the first sample
-                        self._keys = self._keys.at[slot].set(
+                        self._keys[slot] = np.asarray(
                             jax.random.PRNGKey(int(state["seed"])))
                     ftok, key = self._sample_exe(
                         np.asarray(logits), self._keys[slot][None],
                         np.asarray([self._temp[slot]], np.float32),
                         np.asarray([self._topk[slot]], np.int32))
-                    self._keys = self._keys.at[slot].set(key[0])
+                    self._keys[slot] = np.asarray(key)[0]
                     first = int(np.asarray(ftok)[0])
                     self._last_token[slot] = first
                     self._decode_ready[slot] = True
@@ -955,11 +1077,12 @@ class DecodeEngine:
                 with obs_span("serving/decode_step",
                               args={"active": int(ready.size)},
                               jax_annotation=True):
-                    tok, self._k_pool, self._v_pool, self._keys = \
+                    tok, self._k_pool, self._v_pool, keys = \
                         self._decode_exe(self._params, self._k_pool,
                                          self._v_pool, token, pos,
                                          table, self._keys, self._temp,
                                          self._topk)
+                self._keys = np.array(keys)
             tok = np.asarray(tok)
             for s in ready:
                 self._last_token[s] = tok[s]
@@ -1022,10 +1145,11 @@ class DecodeEngine:
         tv = time.perf_counter()
         with obs_span("serving/decode_verify",
                       args={"active": int(ready.size)}, jax_annotation=True):
-            g, samp0, self._k_pool, self._v_pool, self._keys = \
+            g, samp0, self._k_pool, self._v_pool, keys = \
                 self._verify_exe(self._params, self._k_pool, self._v_pool,
                                  ids, start, nappend, table, self._keys,
                                  self._temp, self._topk)
+        self._keys = np.array(keys)
         g = np.asarray(g)                                  # [B, K+1]
         samp0 = np.asarray(samp0)
         verify_ms = (time.perf_counter() - tv) * 1000.0
@@ -1129,4 +1253,16 @@ class DecodeEngine:
                     "verify_ms": self._spec_verify_ms,
                 },
                 "kv": self.kv.stats(),
+                "parallel": {
+                    "mesh": (dict(self.mesh.shape)
+                             if self.mesh is not None else None),
+                    "tp": self._tp,
+                    "ep": self._ep,
+                    "kv_bytes_per_device": (
+                        per_device_bytes(self._k_pool)
+                        + per_device_bytes(self._v_pool)),
+                    "param_bytes_per_device": sum(
+                        per_device_bytes(leaf) for leaf in
+                        jax.tree.leaves(self._params)),
+                },
             }

@@ -34,7 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..analysis.runtime_guards import RecompileGuard
 from ..core import _sharded_trace_guard
 from ..obs.spans import span as obs_span
-from ..sharding import as_sharding_config
+from ..sharding import as_sharding_config, per_device_bytes
 from ..resilience import faults
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
@@ -73,12 +73,18 @@ class InferenceEngine:
     max_batch : int
         Top of the bucket ladder; larger requests run in max_batch chunks.
     mesh : jax.sharding.Mesh | None
-        dp mesh to shard batches over (params replicated).
+        Serving mesh. With only a data axis, batches shard over it and
+        params replicate. With a ``sharding`` config naming ``tp_axis`` /
+        ``ep_axis`` present on the mesh, params shard per the model's
+        megatron rules instead (attention/MLP on heads/hidden over tp,
+        expert banks over ep) and GSPMD partitions each bucket's forward —
+        tensor-parallel predict from the same config the trainer used.
     sharding : ShardingConfig | dict | None
         Declarative placement (``sparkflow_tpu.sharding.ShardingConfig``);
-        serving consumes its ``data_axis``/``dcn_axis`` for batch rows —
-        the same config a Trainer fit used works here unchanged (zero
-        stages only affect training; served params stay replicated).
+        serving consumes its ``data_axis``/``dcn_axis`` for batch rows and
+        ``tp_axis``/``ep_axis`` for model-parallel params — the same config
+        a Trainer fit used works here unchanged (zero stages only affect
+        training). ``quantize`` does not compose with tp/ep.
     quantize : None | 'weight_only' | 'dynamic'
         int8 serving via ``utils.quant``. ``quant_min_size`` forwards to
         :func:`~sparkflow_tpu.utils.quant.quantize_params` (kernels below it
@@ -131,6 +137,18 @@ class InferenceEngine:
             self.model.graphdef.resolve(n)
 
         self._params = self._load_params(weights)
+        # model-parallel predict: a config naming tp_axis/ep_axis present on
+        # the mesh shards attention/MLP weights (megatron rules) and expert
+        # banks instead of replicating — GSPMD partitions the matmuls and
+        # inserts the all-reduces from the param shardings alone
+        self._tp_specs = None
+        mp = (self.mesh is not None
+              and self.sharding.tp_size(self.mesh)
+              * self.sharding.ep_size(self.mesh) > 1)
+        if mp and quantize:
+            raise ValueError("quantize does not compose with tensor/expert-"
+                             "parallel serving (int8 packing breaks the "
+                             "megatron layout); pick one")
         if quantize:
             from ..utils.quant import MODES, quantize_params
             if quantize not in MODES:
@@ -139,7 +157,19 @@ class InferenceEngine:
             self.model.quant_mode = quantize
             self._params = quantize_params(self._params,
                                            min_size=quant_min_size)
-        if self.mesh is not None and self.mesh.size > 1:
+        if mp:
+            if not hasattr(self.model, "param_pspecs"):
+                raise TypeError("model-parallel serving needs the model to "
+                                "publish param_pspecs() (megatron rules)")
+            from ..parallel.tp import (derive_param_pspecs, filter_pspec,
+                                       shard_params)
+            pspecs = derive_param_pspecs(self.model, self.mesh, self.sharding)
+            self._tp_specs = jax.tree.map(
+                lambda s: filter_pspec(s, self.mesh), pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            self._params = shard_params(self._params, self.mesh,
+                                        self._tp_specs)
+        elif self.mesh is not None and self.mesh.size > 1:
             self._params = jax.device_put(
                 self._params, NamedSharding(self.mesh, P()))
 
@@ -258,6 +288,11 @@ class InferenceEngine:
         else:
             predict = _sharded_trace_guard(predict, mesh)
             repl = NamedSharding(mesh, P())
+            # params keep their megatron shardings under tp/ep, else replicate
+            pshard = (jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                   self._tp_specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+                      if self._tp_specs is not None else repl)
             # rows shard over the config's batch axes (data_axis + optional
             # dcn_axis) when the bucket divides their product, else replicate
             cfg = self.sharding
@@ -269,8 +304,18 @@ class InferenceEngine:
                     else repl)
             data = (jax.tree.map(lambda _: rows, self._x_struct(bucket))
                     if self._multi else rows)
-            jitted = jax.jit(predict, in_shardings=(repl, data),
+            jitted = jax.jit(predict, in_shardings=(pshard, data),
                              out_shardings=rows)
+        if (mesh is not None and self.sharding.tp_size(mesh) > 1):
+            # pallas flash attention has no GSPMD partitioning rule; tracing
+            # under this context makes it nest its own shard_map over
+            # batch x heads (falling back to the XLA blockwise path when the
+            # dims don't divide the mesh axes)
+            from ..ops.attention import sharded_attention
+            with sharded_attention(mesh, batch_axis=self.sharding.data_axis,
+                                   head_axis=self.sharding.tp_axis):
+                return jitted.lower(params_struct,
+                                    self._x_struct(bucket)).compile()
         return jitted.lower(params_struct, self._x_struct(bucket)).compile()
 
     def _cache_entries(self) -> int:
@@ -394,4 +439,11 @@ class InferenceEngine:
                      "misses": self.compile_cache_misses}),
                 "quantize": self.quantize,
                 "mesh": (dict(self.mesh.shape) if self.mesh is not None
-                         else None)}
+                         else None),
+                "tp": (self.sharding.tp_size(self.mesh)
+                       if self.mesh is not None else 1),
+                "ep": (self.sharding.ep_size(self.mesh)
+                       if self.mesh is not None else 1),
+                "param_bytes_per_device": sum(
+                    per_device_bytes(leaf)
+                    for leaf in jax.tree.leaves(self._params))}

@@ -387,6 +387,8 @@ class InferenceServer:
             gstats = (gb.engine.stats()
                       if hasattr(gb.engine, "stats") else {})
             kv = gstats.get("kv", {}) if isinstance(gstats, dict) else {}
+            par = (gstats.get("parallel", {})
+                   if isinstance(gstats, dict) else {})
             body["decode"] = {
                 "queue_depth": gb.depth(),
                 "in_flight": gb.inflight_rows(),
@@ -396,6 +398,12 @@ class InferenceServer:
                 "free_slots": (int(kv.get("num_slots", 0))
                                - int(kv.get("slots_active", 0))),
                 "pages_free": int(kv.get("pages_free", 0)),
+                # model-parallel layout: membership/routers export these as
+                # per-replica gauges, and capacity math (pages_free is
+                # per-REPLICA, not per-device) needs the degree
+                "mesh_shape": par.get("mesh"),
+                "tp": int(par.get("tp", 1) or 1),
+                "ep": int(par.get("ep", 1) or 1),
                 "engine": gstats,
             }
             spec = gstats.get("spec", {}) if isinstance(gstats, dict) else {}

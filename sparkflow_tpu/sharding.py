@@ -39,6 +39,12 @@ now consume:
   gather per step (docs/sharding.md).
 - ``offload_opt_state`` — park optimizer state in host memory between
   steps (models whose state exceeds HBM even at 1/dp).
+- ``tp_axis`` / ``ep_axis`` — model-parallel axes for the serving plane
+  (and any GSPMD program that wants them by name): ``tp_axis`` shards
+  attention heads / MLP hidden per megatron rules and the paged KV pool on
+  its heads dimension; ``ep_axis`` shards MoE expert banks. A serving
+  replica with either set is a mesh, not a device — the wire protocol is
+  unchanged (docs/serving.md).
 
 Import discipline: this module imports only jax — ``core``, ``trainer``,
 ``parallel/*``, ``serving`` and ``analysis`` all import it, never the
@@ -66,6 +72,8 @@ class ShardingConfig:
     zero_stage: int = 0
     param_axes: Any = "auto"
     offload_opt_state: bool = False
+    tp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None
 
     def __post_init__(self):
         if self.zero_stage not in ZERO_STAGES:
@@ -84,6 +92,23 @@ class ShardingConfig:
                 f"than data_axis={self.data_axis!r}: the two-level reduction "
                 f"needs a distinct slow (cross-slice) axis next to the fast "
                 f"ICI one")
+        for field in ("tp_axis", "ep_axis"):
+            ax = getattr(self, field)
+            if ax is None:
+                continue
+            if not ax or not isinstance(ax, str):
+                raise ValueError(
+                    f"{field} must be a non-empty mesh axis name or None, "
+                    f"got {ax!r}")
+            if ax in (self.data_axis, self.dcn_axis):
+                raise ValueError(
+                    f"{field}={ax!r} must name a DIFFERENT mesh axis than "
+                    f"data_axis/dcn_axis: model-parallel shards live "
+                    f"orthogonal to the batch axes")
+        if self.tp_axis is not None and self.tp_axis == self.ep_axis:
+            raise ValueError(
+                f"tp_axis and ep_axis both name {self.tp_axis!r}: head/hidden "
+                f"shards and expert shards need distinct mesh axes")
 
     # -- validation ---------------------------------------------------------
 
@@ -112,6 +137,17 @@ class ShardingConfig:
             raise ValueError(
                 f"dcn_axis={self.dcn_axis!r} is not a mesh axis "
                 f"{list(mesh.axis_names)}")
+        for field in ("tp_axis", "ep_axis"):
+            ax = getattr(self, field)
+            if ax is not None and ax not in mesh.axis_names:
+                # a typo'd model axis would silently replicate the weights
+                # the caller meant to shard — exactly the OOM this config
+                # exists to avoid
+                raise ValueError(
+                    f"{field}={ax!r} is not a mesh axis "
+                    f"{list(mesh.axis_names)}. Build the mesh with a "
+                    f"'{ax}' axis (e.g. make_mesh({{'{ax}': N}})) or drop "
+                    f"{field}.")
         return self
 
     # -- derived placements -------------------------------------------------
@@ -151,6 +187,22 @@ class ShardingConfig:
     def shards_params(self) -> bool:
         return self.zero_stage >= 3
 
+    def tp_size(self, mesh: Mesh) -> int:
+        """Tensor-parallel degree on this mesh (1 when unset/absent)."""
+        if self.tp_axis is None:
+            return 1
+        return int(mesh.shape.get(self.tp_axis, 1))
+
+    def ep_size(self, mesh: Mesh) -> int:
+        """Expert-parallel degree on this mesh (1 when unset/absent)."""
+        if self.ep_axis is None:
+            return 1
+        return int(mesh.shape.get(self.ep_axis, 1))
+
+    def model_parallel(self) -> bool:
+        """True when this config asks for any model-parallel axis."""
+        return self.tp_axis is not None or self.ep_axis is not None
+
     def describe(self) -> dict:
         """Flat dict for logs / ``stats()`` / the graftcheck lint."""
         return {
@@ -160,6 +212,8 @@ class ShardingConfig:
             "param_axes": (self.param_axes if isinstance(
                 self.param_axes, (str, type(None))) else "explicit"),
             "offload_opt_state": self.offload_opt_state,
+            "tp_axis": self.tp_axis,
+            "ep_axis": self.ep_axis,
         }
 
     def replace(self, **kw) -> "ShardingConfig":
@@ -170,17 +224,76 @@ class ShardingConfig:
     @classmethod
     def from_legacy(cls, weight_update_sharding: str = "auto",
                     dp_axis: str = "dp", dcn_axis: Optional[str] = None,
-                    param_axes: Any = "auto") -> "ShardingConfig":
+                    param_axes: Any = "auto",
+                    tp_axis: Optional[str] = None,
+                    ep_axis: Optional[str] = None) -> "ShardingConfig":
         """Map the trainer's pre-config knobs onto a ShardingConfig.
         ``'auto'``/``'on'`` request stage 1 (the trainer's eligibility gate
-        may still decline 'auto'); ``'off'`` is stage 0."""
+        may still decline 'auto'); ``'off'`` is stage 0. ``tp_axis``/
+        ``ep_axis`` pass straight through — the legacy knob only ever
+        governed the update pipeline, never model placement."""
         if weight_update_sharding not in ("auto", "on", "off"):
             raise ValueError(
                 f"weight_update_sharding must be 'auto', 'on' or 'off', got "
                 f"{weight_update_sharding!r}")
         stage = 0 if weight_update_sharding == "off" else 1
         return cls(data_axis=dp_axis, dcn_axis=dcn_axis, zero_stage=stage,
-                   param_axes=param_axes)
+                   param_axes=param_axes, tp_axis=tp_axis, ep_axis=ep_axis)
+
+
+def at_rest_leaf_spec(shape, axis: str, *, layout: str,
+                      n_shards: Optional[int] = None,
+                      min_size: int = 2 ** 16) -> P:
+    """THE at-rest sharding decision, shared by every derivation path.
+
+    The repo stores parameters/optimizer state at 1/N per device in two
+    layouts, and both are projections of this one rule — "shard the leaf's
+    shard-bearing dimension over ``axis``; replicate what cannot shard":
+
+    - ``layout='gspmd'`` (the ``fsdp`` axis,
+      :func:`~sparkflow_tpu.parallel.tp.fsdp_pspecs`): the shard-bearing
+      dimension of a tensor kept in model shape is its LARGEST dim; leaves
+      smaller than ``min_size`` elements replicate (sharding them buys
+      nothing and costs a gather).
+    - ``layout='flat'`` (the ZeRO-1/3 flat layout,
+      :func:`~sparkflow_tpu.optimizers_sharded.zero1_state_specs`): every
+      leaf was already flattened/padded to ``[n_shards, ceil(size/n)]``, so
+      the shard-bearing dimension is dim 0 by construction; leaves NOT in
+      the flat layout (scalar counts, schedules) replicate.
+
+    docs/sharding.md documents the two layouts as two spellings of this one
+    decision; keeping the rule in one function is what makes that claim
+    checkable.
+    """
+    if layout == "flat":
+        if len(shape) >= 2 and (n_shards is None or shape[0] == n_shards):
+            return P(axis)
+        return P()
+    if layout == "gspmd":
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if shape and size >= min_size:
+            big = max(range(len(shape)), key=lambda i: shape[i])
+            spec = [None] * len(shape)
+            spec[big] = axis
+            return P(*spec)
+        return P()
+    raise ValueError(
+        f"layout must be 'gspmd' or 'flat', got {layout!r}")
+
+
+def per_device_bytes(a) -> int:
+    """Bytes ONE device actually holds for array ``a``: the first
+    addressable shard's size. Replicated arrays report their full size; a
+    tensor sharded N ways reports ``nbytes / N``. Host numpy (and anything
+    without shards) falls back to full size — this is the at-rest footprint
+    the serving ``stats()`` endpoints report per replica device."""
+    import numpy as np
+    try:
+        return int(a.addressable_shards[0].data.nbytes)
+    except (AttributeError, IndexError):
+        return int(np.asarray(a).nbytes)
 
 
 def as_sharding_config(value) -> ShardingConfig:

@@ -20,15 +20,22 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from sparkflow_tpu.analysis import locks
+from jax.sharding import PartitionSpec as P
+
+from sparkflow_tpu.analysis import jaxpr_lint, locks
+from sparkflow_tpu.jax_compat import shard_map
+from sparkflow_tpu.models import presets
 from sparkflow_tpu.models.registry import build_registry_spec, model_from_json
+from sparkflow_tpu.parallel.mesh import make_mesh
+from sparkflow_tpu.sharding import ShardingConfig
 from sparkflow_tpu.ops import (paged_attention, paged_attention_reference,
                                paged_attention_verify,
                                paged_attention_verify_reference)
 from sparkflow_tpu.ops.attention import last_attention_path
 from sparkflow_tpu.serving import (ContinuousBatcher, DecodeEngine, Draining,
-                                   InferenceServer, OutOfPages, PagedKVCache,
-                                   QueueFull, ServingClient, ServingError)
+                                   InferenceEngine, InferenceServer,
+                                   OutOfPages, PagedKVCache, QueueFull,
+                                   ServingClient, ServingError)
 from sparkflow_tpu.utils.metrics import Metrics
 
 
@@ -1044,6 +1051,286 @@ def test_server_drain_rejects_generate(engine):
         assert ei.value.status == 503
     finally:
         srv.stop()
+
+
+# -- model-parallel decode: tp/ep over the sharded pool -----------------------
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+    return make_mesh({"tp": 2}, devices=jax.devices()[:2])
+
+
+@pytest.fixture(scope="module")
+def engine_tp(lm, tp_mesh):
+    """One tensor-parallel engine for the section, with speculation AND
+    chunked prefill on — every decode feature rides the sharded pool."""
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, spec_k=3, mesh=tp_mesh,
+                       sharding=ShardingConfig(tp_axis="tp"))
+
+
+def test_tp_kernel_heads_sharded_parity(tp_mesh):
+    """The pallas kernels under a heads-axis shard_map — each shard sees its
+    own head slice, identical slot/page grid — match the unsharded kernel.
+    Attention is per-head independent, so the split must be exact."""
+    rs = np.random.RandomState(0)
+    b, h, d, page_size, max_pages = 2, 4, 8, 8, 2
+    q, k, v, table, lens = _rand_paged(rs, b, h, d, page_size, max_pages,
+                                       [5, 11])
+    full = np.asarray(paged_attention(q, k, v, table, lens, interpret=True))
+    fn = shard_map(
+        lambda q, k, v, t, l: paged_attention(q, k, v, t, l, interpret=True),
+        mesh=tp_mesh,
+        in_specs=(P(None, "tp", None), P(None, None, "tp", None),
+                  P(None, None, "tp", None), P(), P()),
+        out_specs=P(None, "tp", None), check_vma=False)
+    out = np.asarray(fn(q, k, v, table, lens))
+    np.testing.assert_allclose(out, full, atol=1e-6, rtol=1e-6)
+
+    # the multi-query verify kernel shards on the same heads axis
+    s = 3
+    qv, kv_, vv, tablev, starts = _rand_paged_verify(
+        rs, b, h, s, d, page_size, 4, [0, 5])
+    fullv = np.asarray(paged_attention_verify(qv, kv_, vv, tablev, starts,
+                                              interpret=True))
+    fnv = shard_map(
+        lambda q, k, v, t, st: paged_attention_verify(q, k, v, t, st,
+                                                      interpret=True),
+        mesh=tp_mesh,
+        in_specs=(P(None, "tp", None, None), P(None, None, "tp", None),
+                  P(None, None, "tp", None), P(), P()),
+        out_specs=P(None, "tp", None, None), check_vma=False)
+    outv = np.asarray(fnv(qv, kv_, vv, tablev, starts))
+    np.testing.assert_allclose(outv, fullv, atol=1e-6, rtol=1e-6)
+
+
+def test_tp_greedy_parity_battery(engine_tp, lm):
+    """tp=2 greedy decode is token-identical to the dense forward across a
+    plain prompt, a prefix-publishing prompt, a chunked-admission prompt,
+    and a prefix-COW replay — speculation on throughout, zero steady-state
+    retraces."""
+    model, params = lm
+    sysp = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13, 12, 10]
+    prompts = [[5, 2, 8],            # plain short
+               sysp + [17, 18],      # publishes the shared prefix blocks
+               list(range(1, 25))]   # 24 tokens: chunked admission
+    for p in prompts:
+        toks, _ = _engine_greedy(engine_tp, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6)
+    # replay: COW prefix hit on the *sharded* pool + speculation together
+    toks, info = _engine_greedy(engine_tp, sysp + [17, 18], 6)
+    assert info["shared_tokens"] == 8
+    assert toks == _dense_greedy(model, params, sysp + [17, 18], 6)
+    st = engine_tp.stats()
+    assert st["steady_traces"] == 0, (
+        f"tensor-parallel decode retraced after warmup: {st}")
+    assert st["spec"]["steps"] > 0
+    assert engine_tp.kv.stats()["prefix_hits"] >= 1
+    par = st["parallel"]
+    assert par["tp"] == 2 and par["ep"] == 1
+    assert par["mesh"] == {"tp": 2}
+
+
+def test_tp_sampling_reproducible(engine_tp):
+    """Same seed -> same sampled path on the sharded engine (the sampler
+    consumes mesh-sharded logits through the same AOT plane)."""
+
+    def run():
+        info = engine_tp.prefill([4, 4], max_new_tokens=4, temperature=1.0,
+                                 top_k=8, seed=123)
+        toks = [] if info["token"] is None else [info["token"]]
+        while len(toks) < 4:
+            out = engine_tp.step()
+            if info["slot"] in out:
+                toks.extend(out[info["slot"]])
+        engine_tp.release(info["slot"])
+        return toks[:4]
+
+    t1, t2 = run(), run()
+    assert t1 == t2
+    assert all(0 <= t < VOCAB for t in t1)
+    assert engine_tp.stats()["steady_traces"] == 0
+
+
+def test_tp_at_rest_bytes_halved(engine_tp, engine_spec):
+    """Sharding the pool on heads halves the at-rest KV bytes per device
+    exactly (same global shape, tp-way split); params shrink too. The
+    baseline engine_spec is constructed identically minus the mesh."""
+    sh = engine_tp.stats()["parallel"]
+    ref = engine_spec.stats()["parallel"]
+    assert ref["tp"] == 1 and sh["tp"] == 2
+    assert sh["kv_bytes_per_device"] * 2 == ref["kv_bytes_per_device"], (
+        sh, ref)
+    assert sh["param_bytes_per_device"] < ref["param_bytes_per_device"]
+
+
+def test_tp_ep_ctor_validation(lm, tp_mesh):
+    """Indivisible heads/experts and missing pspecs surface at construction,
+    before any compile."""
+    model, params = lm
+    if len(jax.devices()) >= 3:
+        mesh3 = make_mesh({"tp": 3}, devices=jax.devices()[:3])
+        with pytest.raises(ValueError):  # num_heads=4 % tp=3
+            DecodeEngine(model, params, num_slots=2, page_size=8,
+                         mesh=mesh3, sharding=ShardingConfig(tp_axis="tp"),
+                         warmup=False)
+        spec = presets.moe_lm(VOCAB, hidden=32, num_layers=2, num_heads=4,
+                              mlp_dim=64, max_len=32, num_experts=4,
+                              moe_every=1)
+        moe = model_from_json(spec)
+        mparams = moe.init(jax.random.PRNGKey(1))
+        mesh_ep3 = make_mesh({"ep": 3}, devices=jax.devices()[:3])
+        with pytest.raises(ValueError):  # num_experts=4 % ep=3
+            DecodeEngine(moe, mparams, num_slots=2, page_size=8,
+                         mesh=mesh_ep3,
+                         sharding=ShardingConfig(ep_axis="ep"), warmup=False)
+
+
+def test_tp_pack_params_column_perm_and_row_bias(lm):
+    """The host-side relayout behind shard_map TP: rank r's contiguous
+    qkv block is exactly [q_r | k_r | v_r] for ITS heads, row-parallel
+    biases pre-divide by tp so the rejoin psum restores them once, and
+    everything else passes through untouched."""
+    from sparkflow_tpu.parallel.tp import tp_pack_params
+    model, params = lm
+    tp = 2
+    H, d = model.num_heads, model.head_dim
+    packed = tp_pack_params(model, params, tp)
+    # tp=1 is the identity (same object, no copies)
+    assert tp_pack_params(model, params, 1) is params
+    blocks = [n for n, sub in params.items()
+              if isinstance(sub, dict) and "qkv_kernel" in sub]
+    assert blocks, "fixture model has no attention blocks?"
+    for name in blocks:
+        orig, new = params[name], packed[name]
+        w = np.asarray(orig["qkv_kernel"])      # [in, 3*H*d], (3, H, d) cols
+        pw = np.asarray(new["qkv_kernel"])
+        cols = w.reshape(w.shape[0], 3, H, d)
+        width = 3 * (H // tp) * d
+        for r in range(tp):
+            # the block-local reshape each rank performs inside shard_map
+            block = pw[:, r * width:(r + 1) * width]
+            block = block.reshape(w.shape[0], 3, H // tp, d)
+            lo, hi = r * (H // tp), (r + 1) * (H // tp)
+            np.testing.assert_array_equal(block, cols[:, :, lo:hi, :])
+        if "qkv_bias" in orig:
+            b = np.asarray(orig["qkv_bias"]).reshape(3, H, d)
+            pb = np.asarray(new["qkv_bias"])
+            for r in range(tp):
+                lo, hi = r * (H // tp), (r + 1) * (H // tp)
+                np.testing.assert_array_equal(
+                    pb[r * width:(r + 1) * width].reshape(3, H // tp, d),
+                    b[:, lo:hi, :])
+        # row-parallel biases: psum over tp ranks must restore them once
+        for bias in ("o_bias", "fc2_bias"):
+            if bias in orig:
+                np.testing.assert_array_equal(
+                    np.asarray(new[bias]) * tp, np.asarray(orig[bias]))
+        # column-natural/replicated leaves pass through untouched
+        for k in orig:
+            if k not in ("qkv_kernel", "qkv_bias", "o_bias", "fc2_bias"):
+                np.testing.assert_array_equal(np.asarray(new[k]),
+                                              np.asarray(orig[k]))
+    with pytest.raises(ValueError, match="num_heads"):
+        tp_pack_params(model, params, 3)  # 4 heads % 3
+    q8 = {n: (dict(sub, qkv_kernel_q8=1) if isinstance(sub, dict)
+              and "qkv_kernel" in sub else sub)
+          for n, sub in params.items()}
+    with pytest.raises(ValueError, match="quantize"):
+        tp_pack_params(model, q8, tp)
+
+
+def test_moe_ep_generate_endpoint_end_to_end(tp_mesh):
+    """MoE decode serves end-to-end through POST /v1/generate with
+    expert-parallel dispatch: the registry preset builds the model, the
+    engine shards the expert banks over ('ep',), /healthz reports the mesh,
+    and the text matches an unsharded engine on the same weights."""
+    mesh = make_mesh({"ep": 2}, devices=jax.devices()[:2])
+    spec = presets.moe_lm(VOCAB, hidden=32, num_layers=2, num_heads=4,
+                          mlp_dim=64, max_len=32, num_experts=4,
+                          router_top_k=2, moe_every=1)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(1))
+    prompt = [3, 1, 4, 1, 5]
+    ref_eng = DecodeEngine(model, params, num_slots=2, page_size=8, seed=0)
+    want, _ = _engine_greedy(ref_eng, prompt, 5)
+    eng = DecodeEngine(model, params, num_slots=2, page_size=8, seed=0,
+                       mesh=mesh, sharding=ShardingConfig(ep_axis="ep"))
+    cb = ContinuousBatcher(eng, max_queue=8)
+    srv = InferenceServer(_EchoEngine(), generate_batcher=cb, port=0).start()
+    try:
+        cli = ServingClient(srv.url, timeout=120)
+        r = cli.generate(prompt, max_new_tokens=5, request_id="moe-ep")
+        assert r["tokens"] == want
+        assert r["finish_reason"] == "length"
+        h = cli.healthz()
+        assert h["decode"]["ep"] == 2
+        assert h["decode"]["mesh_shape"] == {"ep": 2}
+        assert h["decode"]["engine"]["steady_traces"] == 0
+    finally:
+        srv.stop()
+
+
+def test_inference_engine_tp_predict_parity(lm, tp_mesh):
+    """The predict plane under GSPMD tensor parallelism: logits match the
+    replicated engine to float tolerance, params are sharded at rest, and
+    quantize + model-parallel is refused up front."""
+    model, params = lm
+    e1 = InferenceEngine(model, params, input_name="input_ids:0",
+                         output_name="logits:0", max_batch=4)
+    e2 = InferenceEngine(model, params, input_name="input_ids:0",
+                         output_name="logits:0", max_batch=4, mesh=tp_mesh,
+                         sharding=ShardingConfig(tp_axis="tp"))
+    x = np.array([[(i * 7 + k + 1) % VOCAB for k in range(32)]
+                  for i in range(3)], np.int32)
+    o1, o2 = e1.predict(x), e2.predict(x)
+    np.testing.assert_allclose(o1, o2, atol=1e-4, rtol=1e-4)
+    s = e2.stats()
+    assert s["tp"] == 2 and s["ep"] == 1
+    assert s["param_bytes_per_device"] < e1.stats()["param_bytes_per_device"]
+    assert s["steady_traces"] == 0
+    with pytest.raises(ValueError, match="quantize"):
+        InferenceEngine(model, params, input_name="input_ids:0",
+                        output_name="logits:0", max_batch=4, mesh=tp_mesh,
+                        sharding=ShardingConfig(tp_axis="tp"),
+                        quantize="weight_only")
+
+
+def test_decode_lint_planted_defects_both_directions(tp_mesh):
+    """GC-J106 on the decode plane fires both ways: a declared tp axis with
+    no rejoin psum, and a rogue psum over an undeclared axis."""
+    x = jnp.ones((4,), jnp.float32)
+
+    def no_rejoin(v):
+        return v * 2.0
+
+    found = jaxpr_lint.lint_decode_collectives(
+        no_rejoin, (x,), mesh=tp_mesh, in_specs=(P(),), out_specs=P(),
+        tp_axis="tp")
+    assert any(f.rule == "GC-J106" for f in found), found
+
+    def rogue(v):
+        return jax.lax.psum(v, "tp")
+
+    found = jaxpr_lint.lint_decode_collectives(
+        rogue, (x,), mesh=tp_mesh, in_specs=(P(),), out_specs=P())
+    assert any(f.rule == "GC-J106" for f in found), found
+    # and the ignore escape hatch silences it
+    assert jaxpr_lint.lint_decode_collectives(
+        rogue, (x,), mesh=tp_mesh, in_specs=(P(),), out_specs=P(),
+        ignore=("GC-J106",)) == []
+
+
+def test_decode_lint_repo_clean(engine, engine_tp):
+    """The repo's own decode step passes the lint sharded and unsharded:
+    the sharded engine shows the psum rejoin on its declared axis, the
+    TP-less engine shows no collectives at all."""
+    assert jaxpr_lint.lint_decode_step(engine) == []
+    assert jaxpr_lint.lint_decode_step(engine_tp) == []
 
 
 # -- static gates -------------------------------------------------------------

@@ -129,6 +129,73 @@ def test_config_coercion_and_legacy_mapping():
     assert d["zero_stage"] == 3 and d["offload_opt_state"] is True
 
 
+def test_config_model_parallel_axes():
+    """tp_axis/ep_axis: orthogonality to the batch axes is enforced at
+    construction, typo'd axes at validate(), and the introspection helpers
+    report the mesh-resolved degrees."""
+    with pytest.raises(ValueError, match="DIFFERENT mesh axis"):
+        ShardingConfig(tp_axis="dp")  # collides with data_axis
+    with pytest.raises(ValueError, match="DIFFERENT mesh axis"):
+        ShardingConfig(dcn_axis="dcn", ep_axis="dcn")
+    with pytest.raises(ValueError, match="distinct mesh axes"):
+        ShardingConfig(tp_axis="mp", ep_axis="mp")
+    with pytest.raises(ValueError, match="non-empty mesh axis"):
+        ShardingConfig(tp_axis="")
+    with pytest.raises(ValueError, match="tp_axis='tp' is not a mesh axis"):
+        ShardingConfig(tp_axis="tp").validate(make_mesh({"dp": 8}))
+    mp = make_mesh({"tp": 2, "ep": 4})
+    cfg = ShardingConfig(tp_axis="tp", ep_axis="ep").validate(mp)
+    assert cfg.tp_size(mp) == 2 and cfg.ep_size(mp) == 4
+    assert cfg.model_parallel()
+    assert cfg.dp_size(mp) == 1  # dp-less mesh, stage 0: fine
+    plain = ShardingConfig()
+    assert not plain.model_parallel()
+    assert plain.tp_size(mp) == 1 and plain.ep_size(mp) == 1
+    d = cfg.describe()
+    assert d["tp_axis"] == "tp" and d["ep_axis"] == "ep"
+    legacy = ShardingConfig.from_legacy("off", tp_axis="tp", ep_axis="ep")
+    assert (legacy.zero_stage, legacy.tp_axis, legacy.ep_axis) == \
+        (0, "tp", "ep")
+
+
+def test_at_rest_leaf_spec_one_rule_two_layouts():
+    """docs/sharding.md's claim that fsdp (GSPMD) and flat zero-3 are two
+    spellings of ONE per-leaf decision, checked against both consumers."""
+    from jax.sharding import PartitionSpec as P
+
+    from sparkflow_tpu.optimizers_sharded import zero1_state_specs
+    from sparkflow_tpu.parallel.tp import fsdp_pspecs
+    from sparkflow_tpu.sharding import at_rest_leaf_spec
+
+    # gspmd: the LARGEST dim shards, iff the leaf clears min_size
+    assert at_rest_leaf_spec((512, 256), "fsdp", layout="gspmd") == \
+        P("fsdp", None)
+    assert at_rest_leaf_spec((128, 1024), "fsdp", layout="gspmd") == \
+        P(None, "fsdp")
+    assert at_rest_leaf_spec((17,), "fsdp", layout="gspmd") == P()
+    assert at_rest_leaf_spec((4, 4), "fsdp", layout="gspmd",
+                             min_size=8) == P("fsdp", None)
+    assert at_rest_leaf_spec((), "fsdp", layout="gspmd") == P()
+    # flat: dim 0 is shard-bearing by construction ([n_shards, s] leaves)
+    assert at_rest_leaf_spec((8, 37), "dp", layout="flat",
+                             n_shards=8) == P("dp")
+    assert at_rest_leaf_spec((4, 37), "dp", layout="flat",
+                             n_shards=8) == P()  # not the flat layout
+    assert at_rest_leaf_spec((37,), "dp", layout="flat", n_shards=8) == P()
+    with pytest.raises(ValueError, match="'gspmd' or 'flat'"):
+        at_rest_leaf_spec((8, 8), "dp", layout="torus")
+    # both consumers are pure projections of the rule
+    m = _model()
+    specs = fsdp_pspecs(m.param_specs(), min_size=64)
+    for lname, pspec in m.param_specs().items():
+        for pname, (shape, _init) in pspec.items():
+            assert specs[lname][pname] == at_rest_leaf_spec(
+                shape, "fsdp", layout="gspmd", min_size=64), (lname, pname)
+    state = {"mu": jnp.zeros((8, 37)), "count": jnp.zeros(())}
+    ss = zero1_state_specs(state, 8)
+    assert ss["mu"] == P("dp") and ss["count"] == P()
+
+
 # -- stage parity, every registry optimizer ---------------------------------
 
 @pytest.mark.parametrize("opt_name", AVAILABLE_OPTIMIZERS)
