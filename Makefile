@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke zero-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke zero-smoke race-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -124,6 +124,12 @@ zero-smoke:
 # source + the jaxpr self-check over presets x optimizers (docs/analysis.md)
 lint-graft:
 	JAX_PLATFORMS=cpu python -m sparkflow_tpu.analysis sparkflow_tpu examples
+
+# dynamic race smoke: the decode drain-under-load chaos scenario run
+# entirely under the Eraser lockset detector (GC-R402) — zero empty-lockset
+# reports required across engine/KV/metrics shared state (docs/analysis.md)
+race-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/race_smoke.py
 
 # observability smoke: the spans/stepstats/prometheus/request-tracing suite,
 # then the span-overhead micro-bench (docs/observability.md)

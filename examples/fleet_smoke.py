@@ -107,16 +107,41 @@ def wait_healthy(url: str, timeout_s: float = 60.0) -> None:
 
 
 def main() -> None:
+    from sparkflow_tpu.analysis import racecheck
+
     ports = free_ports(N_REPLICAS)
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     procs = {p: spawn_replica(p) for p in ports}
     errors, echoes = [], []
     router = None
+    # SPARKFLOW_TPU_RACECHECK=1 runs the whole chaos burst under the Eraser
+    # lockset detector (zero overhead otherwise); any empty-lockset field in
+    # the router's shared state fails the smoke with both access stacks
+    tracker = racecheck.RaceTracker().install() if racecheck.enabled() \
+        else None
     try:
         for u in urls:
             wait_healthy(u)
         router = RouterServer(urls, probe_interval_s=0.1, recovery_s=0.3,
-                              dispatch_retries=5).start()
+                              dispatch_retries=5)
+        if tracker is not None:  # before start(): threads must see wrappers
+            # wrap Membership._lock FIRST — it is the lock guarding every
+            # per-replica field below; without the wrapper the tracker
+            # can't see it held and reports false empty locksets
+            racecheck.instrument_object(router.membership, name="Membership")
+            for rep in router.membership._replicas:
+                racecheck.instrument_object(
+                    rep, fields=("healthy", "inflight", "queue_depth",
+                                 "successes", "failures"),
+                    name=f"Replica{rep.index}")
+                racecheck.instrument_object(
+                    rep.breaker, fields=("_state", "_consecutive_failures"),
+                    name=f"Replica{rep.index}.breaker")
+            if router.cache is not None:
+                racecheck.instrument_object(
+                    router.cache, fields=("hits", "misses"),
+                    name="ResultCache")
+        router.start()
         print(f"router up on {router.url} fronting {N_REPLICAS} replicas",
               flush=True)
 
@@ -171,11 +196,17 @@ def main() -> None:
         assert health["healthy_replicas"] == N_REPLICAS, health
         counters = probe.metrics()["counters"]
         probe.close()
+        if tracker is not None:
+            tracker.assert_clean()
+            print("racecheck: zero data races across the chaos burst",
+                  flush=True)
         print(f"fleet-smoke OK: {total}/{total} requests served with zero "
               f"failures through kill+restart "
               f"(rerouted={counters.get('router/rerouted', 0):.0f}, "
               f"healthy_replicas={health['healthy_replicas']})", flush=True)
     finally:
+        if tracker is not None:
+            tracker.uninstall()
         if router is not None:
             router.stop()
         for proc in procs.values():

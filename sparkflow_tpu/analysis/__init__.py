@@ -13,9 +13,17 @@ this library API:
   scanned code: host syncs and Python branching inside jit'd functions,
   PRNG key reuse, unhashable static args, and shared-state mutation
   outside the owning class's lock.
+- :mod:`~sparkflow_tpu.analysis.lockgraph` — the whole-package
+  concurrency pass: one cross-module lock-acquisition graph, reporting
+  lock-order cycles (GC-L304) and blocking calls under a held lock
+  (GC-L305).
 - :mod:`~sparkflow_tpu.analysis.runtime_guards` —
   :class:`RecompileGuard` / :func:`track_recompiles`: count jit retraces
   live and name which argument's shape/dtype/static value changed.
+- :mod:`~sparkflow_tpu.analysis.racecheck` — an Eraser-style dynamic
+  lockset race detector (GC-R402) for tests/chaos runs:
+  :class:`RaceTracker` + drop-in lock/attribute instrumentation, enabled
+  by ``SPARKFLOW_TPU_RACECHECK=1`` and free when off.
 
 The repo keeps itself clean under the full pass: ``make lint-graft`` (and
 ``tests/test_analysis.py``) runs it over ``sparkflow_tpu/`` and
@@ -34,7 +42,8 @@ __all__ = [
     "describe_signature_diff",
     "run_static", "run_all",
     "lint_fn", "lint_train_step", "lint_apply",
-    "ast_lint", "locks", "jaxpr_lint", "runtime_guards",
+    "ast_lint", "locks", "lockgraph", "jaxpr_lint", "racecheck",
+    "runtime_guards",
 ]
 
 
@@ -47,6 +56,7 @@ def __getattr__(name):
                        name)
     if name in ("run_static", "run_all"):
         return getattr(importlib.import_module(".cli", __name__), name)
-    if name in ("ast_lint", "locks", "jaxpr_lint", "runtime_guards"):
+    if name in ("ast_lint", "locks", "lockgraph", "jaxpr_lint", "racecheck",
+                "runtime_guards"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

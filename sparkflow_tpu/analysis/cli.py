@@ -1,10 +1,11 @@
 """graftcheck CLI: ``python -m sparkflow_tpu.analysis [paths...]``.
 
-Runs the static passes (ast_lint + lock coverage) over every ``.py`` file
-under the given paths, plus — unless ``--no-trace`` — the jaxpr self-check
-over the repo's model presets and optimizer registry. Exit status is the
-finding count clamped to 1, so CI can gate on it; ``--format json`` emits
-machine-readable findings for tooling.
+Runs the static passes (ast_lint + per-class lock coverage + the
+whole-package lock-order/blocking graph) over every ``.py`` file under the
+given paths, plus — unless ``--no-trace`` — the jaxpr self-check over the
+repo's model presets and optimizer registry. Exit status is the finding
+count clamped to 1, so CI can gate on it; ``--format json`` emits one
+finding object per line (JSONL) for tooling.
 """
 
 from __future__ import annotations
@@ -14,15 +15,17 @@ import json
 import sys
 from typing import List, Optional, Sequence
 
-from . import ast_lint, locks
+from . import ast_lint, lockgraph, locks
 from .findings import RULES, Finding, format_findings
 
 __all__ = ["main", "run_static", "run_all"]
 
 
 def run_static(paths: Sequence[str]) -> List[Finding]:
-    """ast_lint + lock coverage over every .py under ``paths``."""
-    return ast_lint.lint_paths(paths) + locks.lint_paths(paths)
+    """ast_lint + per-class lock coverage + the whole-package lock graph
+    (deadlock/blocking-under-lock) over every .py under ``paths``."""
+    return (ast_lint.lint_paths(paths) + locks.lint_paths(paths)
+            + lockgraph.lint_paths(paths))
 
 
 def run_all(paths: Sequence[str], trace: bool = True,
@@ -73,7 +76,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     findings = run_all(args.paths, trace=not args.no_trace, ignore=ignore)
 
     if args.format == "json":
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+        # JSONL: one finding object per line, so editors/CI can stream-parse
+        # (and `grep GC-L304 | head -1 | jq` just works); clean run = no output
+        for f in findings:
+            print(json.dumps(f.to_dict(), sort_keys=True))
     elif findings:
         print(format_findings(findings))
         print(f"\ngraftcheck: {len(findings)} finding(s)")

@@ -69,10 +69,30 @@ RULES: Dict[str, Tuple[str, str]] = {
     "GC-L303": ("unlocked-call-to-locked-helper",
                 "a *_locked method (caller-holds-the-lock convention) is "
                 "called outside any lock block"),
+    # lock graph (GC-L30x, whole-package): cross-module ordering rules
+    "GC-L304": ("lock-order-cycle",
+                "two locks are acquired in opposite orders on different "
+                "code paths (possibly across modules) — two threads "
+                "interleaving those paths deadlock"),
+    "GC-L305": ("blocking-under-lock",
+                "a blocking operation (sleep, socket/HTTP I/O, "
+                "Future.result, thread join, block_until_ready) runs while "
+                "a lock is held — every other thread needing that lock "
+                "stalls for the full wait"),
     # runtime guards (GC-R4xx)
     "GC-R401": ("excess-retrace",
                 "a guarded function retraced beyond its budget; the "
                 "signature diff names the argument that changed"),
+    "GC-R402": ("empty-lockset-race",
+                "a shared field was accessed from multiple threads with no "
+                "common lock held across all accesses (Eraser lockset "
+                "discipline violated) — a data race, not just a hazard"),
+    # jaxpr lint (continued)
+    "GC-J107": ("collective-divergence",
+                "a collective (psum/all_gather/...) nested under a "
+                "data-dependent cond/while — if devices disagree on the "
+                "predicate, some enter the collective and some don't, and "
+                "the mesh hangs"),
 }
 
 

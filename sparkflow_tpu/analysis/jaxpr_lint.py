@@ -47,6 +47,22 @@ GC-J106  sharding-config-   the collectives actually present in a train
                             TP-less engine must show none (a collective
                             the config doesn't declare means the program
                             and its memory/latency model disagree).
+GC-J107  collective-        a collective (psum/all_gather/psum_scatter/...)
+         divergence         sits inside the branches of a ``lax.cond`` or
+                            the body/condition of a ``lax.while_loop``.
+                            Collectives are rendezvous points: every device
+                            on the axis must reach the same collective the
+                            same number of times. A data-dependent
+                            predicate that evaluates differently across
+                            devices sends some of them into the collective
+                            and some around it — the ones inside wait
+                            forever and the mesh hangs (no error, no
+                            timeout). ``lax.scan`` and unrolled loops are
+                            fine (trip counts are static); a predicate that
+                            is *provably* uniform across the mesh (computed
+                            from fully-replicated values) is a legitimate
+                            suppression — pass ``ignore=("GC-J107",)`` at
+                            that call site.
 """
 
 from __future__ import annotations
@@ -60,12 +76,24 @@ from jax.sharding import PartitionSpec as P
 from .findings import Finding
 
 __all__ = ["lint_fn", "lint_train_step", "lint_sharding_config",
-           "lint_decode_collectives", "lint_decode_step",
-           "lint_dp_train_step", "repo_self_check"]
+           "lint_collective_divergence", "lint_decode_collectives",
+           "lint_decode_step", "lint_dp_train_step", "repo_self_check"]
 
 #: collective primitives whose presence/absence encodes the zero stage
 _SCATTER_PRIMS = frozenset({"reduce_scatter"})
 _REDUCE_PRIMS = frozenset({"psum", "reduce_scatter", "all_reduce"})
+
+#: every primitive that is a cross-device rendezvous (GC-J107). "psum2" is
+#: what lax.psum traces to inside shard_map on current JAX; "pbroadcast" is
+#: deliberately absent — it is shard_map's varying->replicated *type* cast,
+#: not communication, and appears inside branches as plumbing.
+_RENDEZVOUS_PRIMS = frozenset({
+    "psum", "psum2", "all_reduce", "reduce_scatter", "psum_scatter",
+    "all_gather", "all_gather_invariant", "all_to_all", "ppermute",
+    "pmax", "pmin", "pmean"})
+
+#: control-flow primitives whose predicate/trip count is data-dependent
+_DATA_DEP_CONTROL = frozenset({"cond", "while"})
 
 #: below this, replication / double-buffering is noise, not a finding
 DEFAULT_LARGE_BYTES = 1 << 20
@@ -280,6 +308,10 @@ def lint_fn(fn: Callable, args: Sequence, *,
                     f"outputs aval-for-aval but is not donated — add "
                     f"donate_argnums=({i},) to reuse its buffers in place",
                     source="jaxpr_lint", detail={"arg": i, "bytes": total}))
+
+    # GC-J107: collectives under data-dependent control flow (SPMD hang)
+    if "GC-J107" not in ignore:
+        findings.extend(_divergence_findings(jaxpr, label))
     return findings
 
 
@@ -289,6 +321,62 @@ def _take(pool: List, item) -> bool:
         return True
     except ValueError:
         return False
+
+
+# ---------------------------------------------------------------------------
+# GC-J107: collectives under data-dependent control flow
+# ---------------------------------------------------------------------------
+
+
+def _divergence_findings(jaxpr, label: str) -> List[Finding]:
+    """One GC-J107 finding per cond/while eqn with a rendezvous collective
+    anywhere beneath it (nested control flow reports at every level — each
+    predicate on the way down is a place devices can disagree)."""
+    findings: List[Finding] = []
+    for eqn in _iter_eqns(jaxpr):
+        kind = eqn.primitive.name
+        if kind not in _DATA_DEP_CONTROL:
+            continue
+        hits = set()
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                for inner in _iter_eqns(sub):
+                    if inner.primitive.name in _RENDEZVOUS_PRIMS:
+                        hits.add(inner.primitive.name)
+        if not hits:
+            continue
+        where = ("a lax.cond branch" if kind == "cond"
+                 else "the body/condition of a lax.while_loop")
+        findings.append(Finding(
+            "GC-J107",
+            f"{label}: {', '.join(sorted(hits))} inside {where} — a "
+            f"collective is a rendezvous, and a predicate that differs "
+            f"across devices sends some into it and some around it: the "
+            f"mesh hangs. Hoist the collective out of the branch, or if "
+            f"the predicate is provably uniform across the mesh, suppress "
+            f"with ignore=('GC-J107',)",
+            source="jaxpr_lint",
+            detail={"control": kind, "collectives": sorted(hits)}))
+    return findings
+
+
+def lint_collective_divergence(fn: Callable, args: Sequence, *,
+                               mesh=None, in_specs=None, out_specs=None,
+                               name: Optional[str] = None,
+                               ignore: Sequence[str] = ()) -> List[Finding]:
+    """GC-J107 over one traceable function. With ``mesh``/``in_specs`` the
+    function is traced under the same shard_map wrapper the caller compiles
+    (axis-bound collectives only trace inside one)."""
+    if "GC-J107" in set(ignore):
+        return []
+    label = name or getattr(fn, "__name__", "fn")
+    args = tuple(jax.tree.map(_struct_like, a) for a in args)
+    if mesh is not None and in_specs is not None:
+        from ..jax_compat import shard_map
+        fn = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    closed = jax.make_jaxpr(fn)(*args)
+    return _divergence_findings(closed.jaxpr, label)
 
 
 # ---------------------------------------------------------------------------
@@ -457,7 +545,7 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
                             ep_axis: Optional[str] = None,
                             name: Optional[str] = None,
                             ignore: Sequence[str] = ()) -> List[Finding]:
-    """GC-J106 over one decode-plane executable body.
+    """GC-J106 + GC-J107 over one decode-plane executable body.
 
     ``fn`` is the per-shard step function; with ``mesh``/``in_specs`` given
     it is traced under the same shard_map wrapper the engine compiles
@@ -470,7 +558,8 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
     - an axis NOT declared must not appear — an undeclared collective means
       the compiled program and the config everyone budgets from disagree.
     """
-    if "GC-J106" in set(ignore):
+    ignore = set(ignore)
+    if {"GC-J106", "GC-J107"} <= ignore:
         return []
     label = name or getattr(fn, "__name__", "decode_step")
     args = tuple(jax.tree.map(_struct_like, a) for a in args)
@@ -479,6 +568,11 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
         fn = shard_map(fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
     closed = jax.make_jaxpr(fn)(*args)
+    divergence: List[Finding] = []
+    if "GC-J107" not in ignore:
+        divergence = _divergence_findings(closed.jaxpr, label)
+    if "GC-J106" in ignore:
+        return divergence
     observed: set = set()
     for eqn in _iter_eqns(closed.jaxpr):
         if eqn.primitive.name not in _REDUCE_PRIMS:
@@ -512,7 +606,7 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
             f"per-token latency and per-device memory derived from the "
             f"config are wrong for this program",
             source="jaxpr_lint", detail=detail))
-    return findings
+    return findings + divergence
 
 
 def lint_decode_step(engine, *, name: Optional[str] = None,

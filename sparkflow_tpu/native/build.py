@@ -57,8 +57,10 @@ def load_library(verbose: bool = False) -> Optional[ctypes.CDLL]:
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                    *_SOURCES, "-o", tmp]
             try:
-                subprocess.run(cmd, check=True, capture_output=not verbose,
-                               timeout=120)
+                # holding _LOCK across the compile is the point: concurrent
+                # importers must wait for the one build, not race their own
+                subprocess.run(cmd, check=True,  # graftcheck: disable=GC-L305
+                               capture_output=not verbose, timeout=120)
                 os.replace(tmp, path)  # atomic on POSIX
             except Exception as e:  # toolchain missing/broken -> numpy fallback
                 if verbose:

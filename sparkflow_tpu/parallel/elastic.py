@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..analysis import racecheck
 from ..resilience import faults
 
 logger = logging.getLogger("sparkflow_tpu")
@@ -709,6 +710,12 @@ class ElasticDPEngine:
         not retried forever)."""
         runners = self._make_runners(shards, batch_size, epochs, seed)
         self._warmup(runners)
+        # under an active RaceTracker (chaos/test runs), put the store's
+        # hot shared state under lockset tracking; no-op (one None check)
+        # otherwise
+        racecheck.instrument_object(
+            self.store,
+            fields=("_version", "_params", "_opt_state", "_evictions"))
         errors: List[BaseException] = []
 
         def worker(r: _ReplicaRunner):

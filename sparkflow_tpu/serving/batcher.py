@@ -19,9 +19,14 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import (TYPE_CHECKING, Any, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
+
+if TYPE_CHECKING:  # type-only: batcher must not pull in the engines at import
+    from .decode import DecodeEngine
+    from .engine import InferenceEngine
 
 from ..obs import spans as spans_mod
 from ..utils import metrics as metrics_mod
@@ -70,7 +75,8 @@ class MicroBatcher:
         that would exceed it raise :class:`QueueFull`.
     """
 
-    def __init__(self, engine, *, max_batch: Optional[int] = None,
+    def __init__(self, engine: "InferenceEngine", *,
+                 max_batch: Optional[int] = None,
                  max_delay_ms: float = 2.0, max_queue: int = 1024,
                  metrics: Optional[metrics_mod.Metrics] = None,
                  tracer: Optional[spans_mod.Tracer] = None):
@@ -384,7 +390,7 @@ class ContinuousBatcher:
     like the predict path's futures.
     """
 
-    def __init__(self, engine, *, max_queue: int = 256,
+    def __init__(self, engine: "DecodeEngine", *, max_queue: int = 256,
                  prefill_split: bool = False,
                  metrics: Optional[metrics_mod.Metrics] = None,
                  tracer: Optional[spans_mod.Tracer] = None):

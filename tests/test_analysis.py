@@ -571,11 +571,21 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 
 def test_cli_json_format(tmp_path, capsys):
+    # JSONL contract: ONE finding object per line, so CI/editors can
+    # stream-parse and grep; a clean run emits nothing on stdout
     import json
 
     bad = tmp_path / "bad.py"
     bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n")
     assert cli_main([str(bad), "--no-trace", "--format", "json"]) == 1
-    payload = json.loads(capsys.readouterr().out)
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    payload = [json.loads(ln) for ln in lines]  # every line parses alone
     assert payload and payload[0]["rule"] == "GC-A201"
+    assert {"rule", "name", "path", "line", "source", "message"} \
+        <= set(payload[0])
     assert cli_main([str(bad), "--no-trace", "--ignore", "GC-A201"]) == 0
+    capsys.readouterr()
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    assert cli_main([str(good), "--no-trace", "--format", "json"]) == 0
+    assert capsys.readouterr().out.strip() == ""
