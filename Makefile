@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke zero-smoke race-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -100,6 +100,17 @@ tp-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/tp_smoke.py
 	JAX_PLATFORMS=cpu python bench.py --tp-decode
+
+# subprocess hosting a pp=2 stage-sharded engine (staged spec decode +
+# prefix cache + chunked prefill on) — a concurrent mixed-length greedy
+# burst must be token-identical to a pp=1 engine on both staged schedules
+# (single-wave and micro-token wave), zero steady-state retraces, clean
+# SIGTERM drain; finishes with the wave-vs-single-wave decode benchmark
+# (docs/serving.md)
+pp-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/pp_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --pp-decode
 
 # chaos suite: deterministic fault injection against checkpoints, resume,
 # coordinator joins, and serving drain (docs/resilience.md)

@@ -1,0 +1,295 @@
+"""Pipeline-parallel serving smoke: a real server on a 2-stage mesh.
+
+Run via ``make pp-smoke`` (or directly). The script
+
+1. spawns one server *process* (re-invoking itself with ``--server PORT``)
+   hosting a :class:`DecodeEngine` sharded **pipeline-parallel over a
+   2-device ``('pp',)`` mesh** (CPU host devices) — blocks split into two
+   stages, the paged KV pool sharded on its layers axis — with staged
+   self-speculation (``spec_k=3``, ``draft_layers=2`` = the whole first
+   stage), shared-prefix caching AND chunked prefill all enabled, behind
+   a :class:`ContinuousBatcher` with SIGTERM drain handlers installed;
+2. drives a concurrent burst of mixed-length greedy ``/v1/generate``
+   requests — short and long prompts (some crossing the chunked-prefill
+   threshold, repeats hitting the prefix cache), short and long budgets;
+3. asserts every response is **token-identical** to a locally rebuilt
+   ``pp=1`` engine (no mesh, spec off, sharing off, chunking off — the
+   plainest decode path there is), i.e. staging the depth and the KV
+   pool changed where the FLOPs ran, not the text;
+4. replays a subset through a local **wave-scheduled** pp=2 engine
+   (spec off, so ``pp_wave`` engages) and asserts those tokens match
+   too — both staged schedules, single-wave and micro-token wave,
+   agree with flat decode;
+5. checks ``/healthz``'s decode block reports ``pp == 2``,
+   ``stages == 2``, the mesh shape, and **zero** steady-state retraces;
+6. SIGTERMs the server mid-flight and asserts the drain is clean:
+   the in-flight generation completes and the process exits 0.
+
+Everything runs on CPU (``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=2``) in under a minute.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+# The 2-device mesh must exist before jax initialises its backend, in the
+# parent (which builds the pp=1 reference engine; extra devices are
+# harmless) and the ``--server`` child alike.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sparkflow_tpu.utils.hw import ensure_live_backend
+
+ensure_live_backend()
+
+import jax
+
+from sparkflow_tpu.models.registry import build_registry_spec, model_from_json
+from sparkflow_tpu.parallel.mesh import make_mesh
+from sparkflow_tpu.serving import (ContinuousBatcher, DecodeEngine,
+                                   InferenceServer, ServingClient)
+from sparkflow_tpu.sharding import ShardingConfig
+
+VOCAB = 97
+WORKERS = 4
+REQUESTS_PER_WORKER = 4
+SPEC_K = 3
+PP = 2
+DRAFT_LAYERS = 2  # == one whole stage: the draft chain never crosses a cut
+
+
+def build_lm():
+    # 4 layers so the 2-stage split puts DRAFT_LAYERS exactly on the
+    # stage boundary (the staged spec chain requires that)
+    spec = build_registry_spec("transformer_lm", vocab_size=VOCAB, hidden=32,
+                               num_layers=4, num_heads=4, mlp_dim=64,
+                               max_len=64, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def pp_mesh():
+    return make_mesh({"pp": PP}, devices=jax.devices()[:PP])
+
+
+def make_generate_batcher() -> ContinuousBatcher:
+    model, params = build_lm()
+    engine = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                          prefill_chunk=8, spec_k=SPEC_K,
+                          draft_layers=DRAFT_LAYERS, mesh=pp_mesh(),
+                          sharding=ShardingConfig(pp_axis="pp"))
+    return ContinuousBatcher(engine, max_queue=64)
+
+
+class _EchoEngine:
+    """Keeps the predict plane constructible; this smoke only generates."""
+    max_batch = 4
+
+    def predict(self, x):
+        return x
+
+
+def run_server(port: int) -> None:
+    from sparkflow_tpu.resilience.lifecycle import ServerState
+    server = InferenceServer(_EchoEngine(), port=port,
+                             generate_batcher=make_generate_batcher(),
+                             drain_timeout_s=60.0)
+    server.start()
+    server.install_signal_handlers()
+    print(f"pp decode server up on {server.url}", flush=True)
+    while server.lifecycle.state in (ServerState.STARTING,
+                                     ServerState.SERVING):
+        time.sleep(0.2)
+    server.stop()
+    print("pp decode server drained and stopped", flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_healthy(url: str, timeout_s: float = 120.0) -> None:
+    client = ServingClient(url, retries=0)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if client.healthz(timeout_s=1.0)["status"] == "ok":
+                client.close()
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"server at {url} never became healthy")
+
+
+def main() -> None:
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen([sys.executable, __file__, "--server",
+                             str(port)])
+    errors = []
+    results = {}
+    try:
+        wait_healthy(url)
+
+        # mixed-length greedy burst: prompts 2..25 tokens (the long ones
+        # cross the chunked-prefill threshold and, via repeats, hit the
+        # prefix cache), budgets 3..17 — all greedy so every token is
+        # checkable against the unstaged reference
+        def worker(k: int) -> None:
+            client = ServingClient(url, timeout=120, retries=2)
+            for j in range(REQUESTS_PER_WORKER):
+                rid = f"pp-{k}-{j}"
+                n = 2 + (9 * k + 5 * j) % 24
+                prompt = [(i * 13 + k + j) % VOCAB for i in range(n)]
+                budget = 3 + (5 * k + j) % 15
+                try:
+                    r = client.generate(prompt, max_new_tokens=budget,
+                                        temperature=0.0, request_id=rid)
+                    if r["num_tokens"] != budget or \
+                            r["finish_reason"] != "length":
+                        errors.append((rid, f"bad completion: {r}"))
+                    results[(tuple(prompt), budget)] = r["tokens"]
+                except Exception as exc:  # noqa: BLE001
+                    errors.append((rid, exc))
+            client.close()
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(WORKERS)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        elapsed = time.time() - t0
+        assert not errors, (f"{len(errors)} failures, first: {errors[:3]}")
+
+        # a repeated-prompt wave: identical prompts re-submitted so the
+        # server's prefix cache serves them as COW hits on the *staged*
+        # pool while speculation runs
+        client = ServingClient(url, timeout=120)
+        replio = list(results.items())[:4]
+        for (prompt, budget), want in replio:
+            again = client.generate(list(prompt), max_new_tokens=budget,
+                                    temperature=0.0)
+            assert again["tokens"] == want, (again["tokens"], want)
+
+        health = client.healthz()
+        dec = health["decode"]
+        eng_stats = dec["engine"]
+        assert dec["pp"] == PP, f"/healthz decode block lacks pp={PP}: {dec}"
+        assert dec["stages"] == PP, dec
+        assert dec["mesh_shape"] == {"pp": PP}, dec
+        assert eng_stats["steady_traces"] == 0, \
+            f"pipeline-parallel decode retraced after warmup: {eng_stats}"
+        assert eng_stats["spec"]["enabled"] and eng_stats["spec"]["steps"] > 0
+        hits = eng_stats["kv"]["prefix_hits"]
+        assert hits > 0, f"replayed prompts produced no prefix hits: {eng_stats}"
+        par = eng_stats["parallel"]
+        assert par["pp"] == PP and par["stages"] == PP, par
+        kvb = par["kv_bytes_per_device"]
+
+        # token-identical parity vs the plainest possible engine: no mesh,
+        # spec off, sharing off, chunking off — staging the depth must not
+        # change the text
+        model, params = build_lm()
+        ref_cb = ContinuousBatcher(
+            DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                         prefix_cache=False), max_queue=64)
+        try:
+            ref_kvb = ref_cb.engine.stats()["parallel"]["kv_bytes_per_device"]
+            assert kvb * PP <= ref_kvb * 1.1, (kvb, ref_kvb)
+            for (prompt, budget), want in results.items():
+                r = ref_cb.generate(list(prompt), max_new_tokens=budget,
+                                    timeout=120)
+                assert r["tokens"] == want, (prompt[:4], r["tokens"], want)
+        finally:
+            ref_cb.close()
+
+        # the server ran the single-wave staged schedule (spec forces
+        # pp_wave off); replay a subset through a wave-scheduled pp=2
+        # engine so BOTH staged schedules are pinned to the same text
+        model, params = build_lm()
+        wave_cb = ContinuousBatcher(
+            DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                         prefill_chunk=8, mesh=pp_mesh(),
+                         sharding=ShardingConfig(pp_axis="pp")),
+            max_queue=64)
+        try:
+            wpar = wave_cb.engine.stats()["parallel"]
+            assert wpar["pp_wave"], wpar
+            for (prompt, budget), want in list(results.items())[:6]:
+                r = wave_cb.generate(list(prompt), max_new_tokens=budget,
+                                     timeout=120)
+                assert r["tokens"] == want, (prompt[:4], r["tokens"], want)
+            wave_ticks = wave_cb.engine.stats()["parallel"]["wave_ticks"]
+            assert wave_ticks > 0, wave_ticks
+        finally:
+            wave_cb.close()
+
+        # clean SIGTERM drain: in-flight request survives, process exits 0
+        late = {}
+
+        def slow_request() -> None:
+            c = ServingClient(url, timeout=120, retries=0)
+            try:
+                late["result"] = c.generate([1, 2, 3], max_new_tokens=30,
+                                            request_id="drain-rider")
+            except Exception as exc:  # noqa: BLE001
+                late["error"] = exc
+            c.close()
+
+        rider = threading.Thread(target=slow_request)
+        rider.start()
+        time.sleep(0.3)  # let it get admitted
+        proc.send_signal(signal.SIGTERM)
+        rider.join(timeout=120)
+        client.close()
+        assert "result" in late, f"in-flight generation died: {late}"
+        assert late["result"]["num_tokens"] == 30
+
+        proc.wait(timeout=60)
+        assert proc.returncode == 0, \
+            f"server exited {proc.returncode} on SIGTERM drain"
+        total = WORKERS * REQUESTS_PER_WORKER
+        print(f"pp-smoke OK: {total} mixed-length generations in "
+              f"{elapsed:.1f}s on a pp={PP} mesh (spec k={SPEC_K} over "
+              f"draft stage, {hits} prefix hits, {kvb} KV bytes/device vs "
+              f"{ref_kvb} unstaged, {wave_ticks} wave ticks in the replay "
+              f"arm), every token identical to pp=1 decode on both staged "
+              f"schedules, 0 steady-state retraces, clean SIGTERM drain",
+              flush=True)
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--server", type=int, metavar="PORT",
+                        help="internal: run the pp decode server on PORT")
+    ns = parser.parse_args()
+    if ns.server is not None:
+        run_server(ns.server)
+    else:
+        main()

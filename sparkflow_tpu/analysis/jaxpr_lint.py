@@ -543,6 +543,7 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
                             mesh=None, in_specs=None, out_specs=None,
                             tp_axis: Optional[str] = None,
                             ep_axis: Optional[str] = None,
+                            pp_axis: Optional[str] = None,
                             name: Optional[str] = None,
                             ignore: Sequence[str] = ()) -> List[Finding]:
     """GC-J106 + GC-J107 over one decode-plane executable body.
@@ -555,6 +556,12 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
       step's reduction collectives — that psum IS the rejoin after the
       O-projection / MoE combine, and a step without it ships per-shard
       partial activations into the logits;
+    - a declared ``pp_axis`` must appear among the axes of the step's
+      ``ppermute`` handoffs — the ring permute IS the stage-to-stage
+      activation transfer, and a depth-sharded step without it means every
+      stage decodes its local layers in isolation; the pp axis also joins
+      the declared reduce axes (the staged step broadcasts the last stage's
+      sampled token with a select-psum);
     - an axis NOT declared must not appear — an undeclared collective means
       the compiled program and the config everyone budgets from disagree.
     """
@@ -574,16 +581,21 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
     if "GC-J106" in ignore:
         return divergence
     observed: set = set()
+    permuted: set = set()
     for eqn in _iter_eqns(closed.jaxpr):
-        if eqn.primitive.name not in _REDUCE_PRIMS:
+        is_reduce = eqn.primitive.name in _REDUCE_PRIMS
+        if not is_reduce and eqn.primitive.name != "ppermute":
             continue
         axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
         if not isinstance(axes, (tuple, list)):
             axes = (axes,)
-        observed.update(a for a in axes if isinstance(a, str))
+        (observed if is_reduce else permuted).update(
+            a for a in axes if isinstance(a, str))
     findings: List[Finding] = []
     detail = {"observed_axes": sorted(observed),
-              "declared": {"tp_axis": tp_axis, "ep_axis": ep_axis}}
+              "observed_ppermute_axes": sorted(permuted),
+              "declared": {"tp_axis": tp_axis, "ep_axis": ep_axis,
+                           "pp_axis": pp_axis}}
     for role, axis in (("tp_axis", tp_axis), ("ep_axis", ep_axis)):
         if axis is not None and axis not in observed:
             what = ("O-projection/MLP rejoin" if role == "tp_axis"
@@ -596,7 +608,27 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
                 f"served logits are garbage (check the axis reached the "
                 f"model's decode_step)",
                 source="jaxpr_lint", detail=detail))
-    declared = {a for a in (tp_axis, ep_axis) if a is not None}
+    if pp_axis is not None and pp_axis not in permuted:
+        findings.append(Finding(
+            "GC-J106",
+            f"{label}: declared pp_axis={pp_axis!r} but the decode step "
+            f"contains no ppermute over it — the stage-to-stage activation "
+            f"handoff is missing, so each stage runs only its local layers "
+            f"and the served logits never saw the full depth (check the "
+            f"axis reached the staged step builder)",
+            source="jaxpr_lint", detail=detail))
+    extra_perm = permuted - ({pp_axis} if pp_axis is not None else set())
+    if extra_perm:
+        findings.append(Finding(
+            "GC-J106",
+            f"{label}: the decode step runs ppermute over "
+            f"{sorted(extra_perm)} without a declared pp_axis — the "
+            f"program is depth-sharded but the config everyone budgets "
+            f"from says it is not",
+            source="jaxpr_lint", detail=detail))
+    # pp joins the declared reduce axes: the staged step's exit broadcast
+    # (select-psum of the last stage's token/logits) is over pp_axis
+    declared = {a for a in (tp_axis, ep_axis, pp_axis) if a is not None}
     extra = observed - declared
     if extra:
         findings.append(Finding(
@@ -614,7 +646,8 @@ def lint_decode_step(engine, *, name: Optional[str] = None,
     """GC-J106 for a live :class:`~sparkflow_tpu.serving.decode.DecodeEngine`:
     trace its steady-state decode step exactly as warmup compiles it (same
     shard_map wrapper and specs when model-parallel) and check the observed
-    collectives against the tp/ep axes the engine declares. Zero findings is
+    collectives against the tp/ep/pp axes the engine declares (a pp engine
+    must show the ppermute stage handoff). Zero findings is
     the repo gate; both planted-defect directions live in
     ``tests/test_decode.py``."""
     import jax.numpy as jnp
@@ -637,8 +670,9 @@ def lint_decode_step(engine, *, name: Optional[str] = None,
     return lint_decode_collectives(
         engine._decode_fn, args, mesh=mesh, in_specs=in_specs,
         out_specs=out_specs, tp_axis=engine._tp_axis,
-        ep_axis=engine._ep_axis,
-        name=name or f"decode_step[tp={engine._tp},ep={engine._ep}]",
+        ep_axis=engine._ep_axis, pp_axis=engine._pp_axis,
+        name=name or (f"decode_step[tp={engine._tp},ep={engine._ep},"
+                      f"pp={engine._pp}]"),
         ignore=ignore)
 
 

@@ -394,6 +394,99 @@ class TransformerLM(_TransformerBase):
                  "v": cache["v"].at[layer].set(v)}
         return out.astype(q.dtype), cache
 
+    # -- stage-level pieces ---------------------------------------------------
+    #
+    # The pipeline-parallel decode engine (serving/decode.py with
+    # ``pp_axis`` set) rebuilds decode_step/prefill/... as STAGED programs:
+    # every pp stage holds only its own blocks (parallel/pp.py layout), so
+    # the embed / per-block / head pieces must be callable separately, with
+    # stage-LOCAL layer indices. Each whole-model method below is the
+    # composition of these pieces — the architecture stays defined once.
+
+    def decode_embed(self, params, token, pos):
+        """Embed one token per row: ``token``/``pos`` [B] int32 ->
+        [B, 1, hidden] in compute dtype. ``params`` needs only the shared
+        (stage-replicated) ``embed`` subtree."""
+        token = token.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        x = jnp.take(params["embed"]["tok"], token, axis=0)
+        posemb = jnp.take(params["embed"]["pos"],
+                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
+        return self.cast(x + posemb)[:, None, :]
+
+    def suffix_embed(self, params, ids, start):
+        """Embed a token block ``ids`` [B,S] whose first token sits at
+        absolute position ``start`` [B] -> [B, S, hidden]."""
+        ids = ids.astype(jnp.int32)
+        s = ids.shape[1]
+        start = start.astype(jnp.int32)
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        posemb = jnp.take(params["embed"]["pos"],
+                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
+        return self.cast(x + posemb)
+
+    def prefill_embed(self, params, ids):
+        """Embed a full (padded) prompt ``ids`` [B,S] -> [B, S, hidden]."""
+        ids = ids.astype(jnp.int32)
+        s = ids.shape[1]
+        x = jnp.take(params["embed"]["tok"], ids, axis=0)
+        return self.cast(x + params["embed"]["pos"][:s][None, :, :])
+
+    def head_all(self, params, x):
+        """Final LN + tied-embedding head at every position:
+        x [B,S,hidden] -> logits [B,S,vocab] f32."""
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        return jnp.matmul(x.astype(jnp.float32),
+                          params["embed"]["tok"].T.astype(jnp.float32))
+
+    def decode_head(self, params, x):
+        """Final LN + tied head for a single-token activation
+        x [B,1,hidden] -> logits [B,vocab] f32."""
+        return self.head_all(params, x)[:, 0]
+
+    def head_last(self, params, x, lengths=None):
+        """Final LN + tied head at the last valid position of x [B,S,hidden]
+        (``lengths`` [B] counts valid tokens, default S) -> [B,vocab] f32."""
+        b, s, _ = x.shape
+        x = _layer_norm(x, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
+        if lengths is None:
+            last = jnp.full((b,), s - 1, jnp.int32)
+        else:
+            last = jnp.clip(lengths.astype(jnp.int32) - 1, 0, s - 1)
+        x_last = x[jnp.arange(b), last]                    # [B, hidden]
+        return jnp.matmul(x_last.astype(jnp.float32),
+                          params["embed"]["tok"].T.astype(jnp.float32))
+
+    def block_decode(self, bp, x, layer, cache, pos, attend,
+                     tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None):
+        """Public single-block decode step (see :meth:`_block_decode`);
+        ``layer`` is whatever index ``attend`` expects — the pp engine passes
+        stage-local indices against a layers-sharded pool."""
+        return self._block_decode(bp, x, layer, cache, pos, attend,
+                                  tp_axis=tp_axis, ep_axis=ep_axis)
+
+    def block_suffix(self, bp, x, layer, cache, start, attend,
+                     tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None):
+        """Public single-block suffix step (see :meth:`_block_suffix`)."""
+        return self._block_suffix(bp, x, layer, cache, start, attend,
+                                  tp_axis=tp_axis, ep_axis=ep_axis)
+
+    def block_prefill(self, bp, x, mask=None,
+                      tp_axis: Optional[str] = None,
+                      ep_axis: Optional[str] = None):
+        """Public single-block causal prefill step returning this block's
+        keys/values for the decode cache: ``(x, k, v)`` with k/v
+        [B,heads,S,d] (local heads under tp)."""
+        x, _, k, v = self._block(bp, x, mask, True, False,
+                                 jax.random.PRNGKey(0), with_kv=True,
+                                 tp_axis=tp_axis, ep_axis=ep_axis)
+        return x, k, v
+
     def decode_step(self, params, cache, token, pos, attend=None,
                     num_layers: Optional[int] = None,
                     tp_axis: Optional[str] = None,
@@ -422,21 +515,13 @@ class TransformerLM(_TransformerBase):
         if attend is None:
             attend = self._dense_cache_attend
         L = self.num_layers if num_layers is None else int(num_layers)
-        token = token.astype(jnp.int32)
         pos = pos.astype(jnp.int32)
-        x = jnp.take(params["embed"]["tok"], token, axis=0)
-        posemb = jnp.take(params["embed"]["pos"],
-                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
-        x = self.cast(x + posemb)[:, None, :]              # [B, 1, hidden]
+        x = self.decode_embed(params, token, pos)          # [B, 1, hidden]
         for i in range(L):
             x, cache = self._block_decode(params[f"block_{i}"], x, i, cache,
                                           pos, attend, tp_axis=tp_axis,
                                           ep_axis=ep_axis)
-        x = _layer_norm(x, params["final_ln"]["scale"],
-                        params["final_ln"]["bias"])
-        logits = jnp.matmul(x[:, 0].astype(jnp.float32),
-                            params["embed"]["tok"].T.astype(jnp.float32))
-        return logits, cache
+        return self.decode_head(params, x), cache
 
     def decode_verify(self, params, ids, start, cache, attend,
                       tp_axis: Optional[str] = None,
@@ -448,23 +533,13 @@ class TransformerLM(_TransformerBase):
         — so one call scores a drafted token block: ``logits[:, j]`` is the
         target model's next-token distribution after prefix + drafts[:j].
         ``tp_axis``/``ep_axis``: as in :meth:`decode_step`."""
-        ids = ids.astype(jnp.int32)
-        b, s = ids.shape
         start = start.astype(jnp.int32)
-        x = jnp.take(params["embed"]["tok"], ids, axis=0)
-        pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
-        posemb = jnp.take(params["embed"]["pos"],
-                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
-        x = self.cast(x + posemb)
+        x = self.suffix_embed(params, ids, start)
         for i in range(self.num_layers):
             x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
                                           start, attend, tp_axis=tp_axis,
                                           ep_axis=ep_axis)
-        x = _layer_norm(x, params["final_ln"]["scale"],
-                        params["final_ln"]["bias"])
-        logits = jnp.matmul(x.astype(jnp.float32),
-                            params["embed"]["tok"].T.astype(jnp.float32))
-        return logits, cache
+        return self.head_all(params, x), cache
 
     def prefill(self, params, ids, mask=None, lengths=None,
                 tp_axis: Optional[str] = None,
@@ -476,27 +551,13 @@ class TransformerLM(_TransformerBase):
         (default: the full row, ``S``). ``tp_axis``/``ep_axis``: as in
         :meth:`decode_step`; under tp the returned k/v carry the shard's
         *local* heads — exactly the slice its heads-sharded pool stores."""
-        ids = ids.astype(jnp.int32)
-        b, s = ids.shape
-        x = jnp.take(params["embed"]["tok"], ids, axis=0)
-        x = self.cast(x + params["embed"]["pos"][:s][None, :, :])
-        rng = jax.random.PRNGKey(0)
+        x = self.prefill_embed(params, ids)
         kvs = []
         for i in range(self.num_layers):
-            x, rng, k, v = self._block(params[f"block_{i}"], x, mask, True,
-                                       False, rng, with_kv=True,
-                                       tp_axis=tp_axis, ep_axis=ep_axis)
+            x, k, v = self.block_prefill(params[f"block_{i}"], x, mask,
+                                         tp_axis=tp_axis, ep_axis=ep_axis)
             kvs.append((k, v))
-        x = _layer_norm(x, params["final_ln"]["scale"],
-                        params["final_ln"]["bias"])
-        if lengths is None:
-            last = jnp.full((b,), s - 1, jnp.int32)
-        else:
-            last = jnp.clip(lengths.astype(jnp.int32) - 1, 0, s - 1)
-        x_last = x[jnp.arange(b), last]                    # [B, hidden]
-        logits = jnp.matmul(x_last.astype(jnp.float32),
-                            params["embed"]["tok"].T.astype(jnp.float32))
-        return logits, kvs
+        return self.head_last(params, x, lengths), kvs
 
     def prefill_suffix(self, params, ids, start, cache, attend, lengths=None,
                        tp_axis: Optional[str] = None,
@@ -512,28 +573,13 @@ class TransformerLM(_TransformerBase):
         only the un-shared / not-yet-committed tokens are ever forwarded.
         Returns ``(logits [B, vocab] at the last valid suffix position,
         cache)``; ``lengths`` [B] counts valid suffix tokens (default S)."""
-        ids = ids.astype(jnp.int32)
-        b, s = ids.shape
         start = start.astype(jnp.int32)
-        x = jnp.take(params["embed"]["tok"], ids, axis=0)
-        pos = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
-        posemb = jnp.take(params["embed"]["pos"],
-                          jnp.clip(pos, 0, self.max_len - 1), axis=0)
-        x = self.cast(x + posemb)
+        x = self.suffix_embed(params, ids, start)
         for i in range(self.num_layers):
             x, cache = self._block_suffix(params[f"block_{i}"], x, i, cache,
                                           start, attend, tp_axis=tp_axis,
                                           ep_axis=ep_axis)
-        x = _layer_norm(x, params["final_ln"]["scale"],
-                        params["final_ln"]["bias"])
-        if lengths is None:
-            last = jnp.full((b,), s - 1, jnp.int32)
-        else:
-            last = jnp.clip(lengths.astype(jnp.int32) - 1, 0, s - 1)
-        x_last = x[jnp.arange(b), last]
-        logits = jnp.matmul(x_last.astype(jnp.float32),
-                            params["embed"]["tok"].T.astype(jnp.float32))
-        return logits, cache
+        return self.head_last(params, x, lengths), cache
 
     def _loss(self, params, feeds, train, rng):
         ids = feeds["input_ids"].astype(jnp.int32)

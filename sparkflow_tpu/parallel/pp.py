@@ -92,6 +92,22 @@ def pp_pspecs(pp_params):
     return {"stages": stages, "shared": shared}
 
 
+def split_stage_pspecs(pp_axis: str, block_pspecs, shared_pspecs):
+    """PartitionSpecs for the :func:`split_stage_params` layout that KEEP
+    per-block leaf sharding: every stage leaf becomes
+    ``P(pp_axis, None, *block_leaf_spec)`` — the leading stage axis shards
+    over ``pp_axis``, the blocks-per-stage axis stays replicated, and the
+    original per-block axes (e.g. megatron ``tp`` columns) ride behind. This
+    is how the serving engine composes a 2D ``pp x tp`` mesh: depth shards
+    via the stage stack, width via the block leaves. ``block_pspecs`` is the
+    spec tree for ONE block; ``shared_pspecs`` passes through for the
+    stage-replicated embed/final_ln leaves."""
+    stages = jax.tree.map(lambda sp: P(pp_axis, None, *tuple(sp)),
+                          block_pspecs,
+                          is_leaf=lambda x: isinstance(x, P))
+    return {"stages": stages, "shared": shared_pspecs}
+
+
 _OP_NONE, _OP_FWD, _OP_BWD = 0, 1, 2
 
 

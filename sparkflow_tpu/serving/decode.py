@@ -72,6 +72,19 @@ sampled from the verify logits with the same per-slot key cadence as the
 plain step). Draft + verify + rollback-copy are a bounded set of extra AOT
 shapes, so the zero-steady-state-retrace invariant holds unchanged.
 
+**Pipeline-parallel decode** (``sharding`` naming a ``pp_axis``) splits the
+transformer's depth into ``pp`` stages: each stage holds only its own
+blocks' weights and its own LAYERS-slice of the paged pool, activations hop
+stage-to-stage on a ``ppermute`` ring inside the same shard_map that
+carries tp, and every staged program keeps the no-cond discipline (all
+stages compute every pass; inactive stages select their output away and
+write K/V to scratch) so no collective ever sits under data-dependent
+control flow. The naive staged step idles ``pp - 1`` stages per token, so
+**micro-token wave scheduling** (``pp_wave=True``) partitions the live
+slots into ``pp`` waves that occupy the pipeline simultaneously: one tick
+per :meth:`step`, stage ``s`` decoding wave ``(t - s) mod pp``, one
+fixed-shape AOT tick executable, zero steady-state retraces.
+
 The engine is mechanism only — slot admission at token boundaries, queueing,
 futures and drain semantics live in
 :class:`~sparkflow_tpu.serving.batcher.ContinuousBatcher`.
@@ -159,19 +172,32 @@ class DecodeEngine:
         prefills at admission through its own AOT ladder.
     mesh : jax.sharding.Mesh | None
         Serving mesh for model-parallel decode. With a ``sharding`` config
-        naming ``tp_axis`` / ``ep_axis`` present on this mesh, every
-        decode-plane executable becomes a shard_map over those axes:
-        attention/MLP weights and the KV pool's heads axis shard over tp
-        (each shard runs the unmodified pallas kernels on its own head
+        naming ``tp_axis`` / ``ep_axis`` / ``pp_axis`` present on this
+        mesh, every decode-plane executable becomes a shard_map over those
+        axes: attention/MLP weights and the KV pool's heads axis shard over
+        tp (each shard runs the unmodified pallas kernels on its own head
         slice, one psum after the O-projection / MLP rejoins activations),
-        expert banks shard over ep. Greedy output is token-identical to the
-        unsharded engine; an external ``draft_model`` stays replicated off
-        the mesh.
+        expert banks shard over ep, and transformer DEPTH shards over pp —
+        blocks split into ``pp`` stages (the ``parallel/pp.py`` layout),
+        the pool's layers axis shards with them, and activations hand
+        stage-to-stage on a ``ppermute`` ring inside the same shard_map
+        (``pp x tp`` composes as a 2D mesh; pp + ep is refused). Greedy
+        output is token-identical to the unsharded engine; an external
+        ``draft_model`` stays replicated off the mesh.
     sharding : ShardingConfig | dict | str | None
         Declarative axis naming (see :mod:`sparkflow_tpu.sharding`). Only
-        ``tp_axis`` / ``ep_axis`` are consulted here; axes absent from the
-        mesh (or of size 1) deactivate, so one config serves both sharded
-        and single-device deployments.
+        ``tp_axis`` / ``ep_axis`` / ``pp_axis`` are consulted here; axes
+        absent from the mesh (or of size 1) deactivate, so one config
+        serves both sharded and single-device deployments.
+    pp_wave : bool
+        Micro-token wave scheduling (on by default, effective only with an
+        active ``pp_axis`` and ``spec_k == 0``): live slots partition into
+        ``pp`` waves that occupy the pipeline simultaneously — each
+        :meth:`step` is one tick in which stage ``s`` decodes wave
+        ``(t - s) mod pp``, so every stage stays busy and the pipeline
+        bubble survives only at drain/refill edges. ``False`` keeps the
+        single-wave staged step (all slots traverse all stages per call —
+        same tokens, ``(pp-1)/pp`` of the mesh idle at any instant).
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -182,7 +208,7 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  spec_k: int = 0, draft_layers: Optional[int] = None,
                  draft_model=None, draft_params=None,
-                 mesh=None, sharding=None,
+                 mesh=None, sharding=None, pp_wave: bool = True,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -201,22 +227,28 @@ class DecodeEngine:
         self.sharding = None
         self._tp_axis: Optional[str] = None
         self._ep_axis: Optional[str] = None
+        self._pp_axis: Optional[str] = None
         self._tp = 1
         self._ep = 1
+        self._pp = 1
         if sharding is not None:
             from ..sharding import as_sharding_config
             self.sharding = as_sharding_config(sharding)
             if mesh is None and self.sharding.model_parallel():
-                raise ValueError("sharding names tp_axis/ep_axis but no mesh "
-                                 "was given; pass mesh= to DecodeEngine")
+                raise ValueError("sharding names tp_axis/ep_axis/pp_axis but "
+                                 "no mesh was given; pass mesh= to "
+                                 "DecodeEngine")
         if self.mesh is not None and self.sharding is not None:
             self.sharding.validate(self.mesh, require_data_axis=False)
             tp_ax, ep_ax = self.sharding.tp_axis, self.sharding.ep_axis
+            pp_ax = self.sharding.pp_axis
             if tp_ax and int(self.mesh.shape[tp_ax]) > 1:
                 self._tp_axis, self._tp = tp_ax, int(self.mesh.shape[tp_ax])
             if ep_ax and int(self.mesh.shape[ep_ax]) > 1:
                 self._ep_axis, self._ep = ep_ax, int(self.mesh.shape[ep_ax])
-        self._sharded = self._tp * self._ep > 1
+            if pp_ax and int(self.mesh.shape[pp_ax]) > 1:
+                self._pp_axis, self._pp = pp_ax, int(self.mesh.shape[pp_ax])
+        self._sharded = self._tp * self._ep * self._pp > 1
         if self._tp > 1 and int(model.num_heads) % self._tp:
             raise ValueError(f"num_heads={model.num_heads} is not divisible "
                              f"by tp={self._tp}")
@@ -228,6 +260,24 @@ class DecodeEngine:
             if int(n_exp) % self._ep:
                 raise ValueError(f"num_experts={n_exp} is not divisible by "
                                  f"ep={self._ep}")
+        if self._pp > 1:
+            if self._ep > 1:
+                raise ValueError(
+                    "pp_axis does not compose with ep_axis: expert dispatch "
+                    "reduces inside the block body, which the staged no-cond "
+                    "schedule would re-run on every stage. Shard depth (pp) "
+                    "x width (tp) instead.")
+            if int(model.num_layers) % self._pp:
+                raise ValueError(
+                    f"num_layers={model.num_layers} is not divisible by "
+                    f"pp={self._pp}: each pipeline stage must hold the same "
+                    f"number of blocks")
+            for need in ("decode_embed", "block_decode", "decode_head"):
+                if not hasattr(model, need):
+                    raise TypeError(
+                        f"pipeline-parallel decode needs the model to expose "
+                        f"stage-level pieces ({need}()); use a "
+                        f"transformer_lm")
         if self._sharded and not hasattr(model, "param_pspecs"):
             raise TypeError("model-parallel decode needs the model to "
                             "publish param_pspecs() (megatron rules)")
@@ -299,9 +349,26 @@ class DecodeEngine:
                 if not 1 <= L <= int(model.num_layers):
                     raise ValueError(
                         f"draft_layers={L} outside [1, {model.num_layers}]")
+                if self._pp > 1 and L % (int(model.num_layers) // self._pp):
+                    raise ValueError(
+                        f"draft_layers={L} must be a whole number of "
+                        f"pipeline stages (stage depth = "
+                        f"{int(model.num_layers) // self._pp}) so the "
+                        f"self-speculation chain exits at a stage boundary")
                 self.draft_layers = L
         elif draft_model is not None or draft_layers:
             raise ValueError("draft_model / draft_layers require spec_k >= 1")
+        # micro-token wave scheduling: live slots partition into pp waves
+        # that occupy the pipeline simultaneously (stage s decodes wave
+        # (t - s) mod pp at tick t), amortizing the pipeline bubble away.
+        # The speculative step already amortizes depth over its multi-token
+        # chunk, so waves stand down when speculation is on.
+        self._pp_wave = bool(pp_wave) and self._pp > 1 and not self.spec_k
+        if self._pp_wave and self.num_slots % self._pp:
+            raise ValueError(
+                f"num_slots={num_slots} is not divisible by pp={self._pp}: "
+                f"wave scheduling partitions the slot lanes into pp equal "
+                f"waves (pass pp_wave=False for the single-wave schedule)")
 
         if isinstance(params, (list, tuple)):
             from ..graphdef import list_to_params
@@ -316,21 +383,46 @@ class DecodeEngine:
                 # row-parallel biases so the decode psums are exact
                 params = tp_pack_params(model, params, self._tp)
             pspecs = derive_param_pspecs(model, self.mesh, self.sharding)
+            if pspecs is None:
+                # pp-only mesh: no tp/ep axis shards weight columns, every
+                # leaf starts replicated (the stage split below re-lays the
+                # block leaves out over pp_axis)
+                pspecs = jax.tree.map(lambda s: P(), model.param_pspecs(),
+                                      is_leaf=lambda x: isinstance(x, P))
             self._param_specs = jax.tree.map(
                 lambda s: filter_pspec(s, self.mesh), pspecs,
                 is_leaf=lambda x: isinstance(x, P))
+            if self._pp > 1:
+                # depth split (parallel/pp.py layout): per-block leaves
+                # stack to [pp, layers/pp, ...] with the leading stage axis
+                # sharded over pp_axis — each stage holds only its own
+                # blocks' weights at rest. embed/final_ln replicate: every
+                # stage runs entry/exit unconditionally in the no-cond
+                # staged schedule, and the block leaves keep any megatron
+                # tp columns behind the stage axes (2D pp x tp).
+                from ..parallel.pp import (split_stage_params,
+                                           split_stage_pspecs)
+                params = split_stage_params(model, params, self._pp)
+                self._param_specs = split_stage_pspecs(
+                    self._pp_axis, self._param_specs["block_0"],
+                    {k: v for k, v in self._param_specs.items()
+                     if not k.startswith("block_")})
             params = shard_params(params, self.mesh, self._param_specs)
         self._params = params
         pool_dtype = (model.compute_dtype if model.compute_dtype is not None
                       else jnp.float32)
         # GLOBAL pool shape; under tp the heads axis shards across the mesh
-        # ([layers, pages, page, heads/tp, d] per device), which leaves the
-        # pallas kernels' slot/page grids untouched — each shard runs the
-        # unmodified kernel over its own head slice
+        # ([layers, pages, page, heads/tp, d] per device) and under pp the
+        # LAYERS axis shards ([layers/pp, ...] per stage — each stage
+        # allocates and gathers only its own layers' pages), both of which
+        # leave the pallas kernels' slot/page grids untouched — each shard
+        # runs the unmodified kernel over its own layer/head slice. The
+        # host-global page bookkeeping (refcounts, prefix trie, COW) is
+        # layout-blind either way.
         pool_shape = (model.num_layers, num_pages, self.page_size,
                       model.num_heads, model.head_dim)
-        self._pool_spec = (P(None, None, None, self._tp_axis, None)
-                           if self._tp_axis else P())
+        self._pool_spec = (P(self._pp_axis, None, None, self._tp_axis, None)
+                           if (self._tp_axis or self._pp_axis) else P())
         if self._sharded:
             ns = NamedSharding(self.mesh, self._pool_spec)
             self._k_pool = jax.device_put(jnp.zeros(pool_shape, pool_dtype), ns)
@@ -363,6 +455,18 @@ class DecodeEngine:
         # fixed-shape step masks them to scratch until their K/V is committed
         self._decode_ready = np.zeros(self.num_slots, bool)
         self._pending: List[Dict[str, Any]] = []  # chunked-prefill states
+        # wave scheduling state: the stage-to-stage activation ring (a
+        # [pp, W, 1, hidden] carry whose leading axis shards over pp_axis),
+        # the tick counter, and which slots ride each in-flight wave
+        self._x_carry = None
+        self._tick = 0
+        self._wave_inflight: Dict[int, List[int]] = {}
+        if self._pp_wave:
+            W = self.num_slots // self._pp
+            xc = jnp.zeros((self._pp, W, 1, int(model.hidden)), pool_dtype)
+            self._x_carry = jax.device_put(
+                xc, NamedSharding(self.mesh, P(self._pp_axis)))
+            self._wave_inflight = {w: [] for w in range(self._pp)}
 
         self._lock = threading.Lock()
         # expected traces: one per prefill bucket + decode + prefill sampler
@@ -376,12 +480,14 @@ class DecodeEngine:
         self.recompile_guard = RecompileGuard(
             name="serving.decode",
             warn_after=len(self.prefill_buckets) + 3
-            + (1 if self.prefill_chunk else 0) + spec_shapes)
+            + (1 if self.prefill_chunk else 0)
+            + (1 if self._pp_wave else 0) + spec_shapes)
         self._prefill_exes: Dict[int, Any] = {}
         self._decode_exe: Any = None
         self._sample_exe: Any = None
         self._suffix_exe: Any = None
         self._fused_exe: Any = None
+        self._tick_exe: Any = None
         self._draft_exe: Any = None
         self._verify_exe: Any = None
         self._copy_exe: Any = None
@@ -396,6 +502,16 @@ class DecodeEngine:
         self._spec_accepted = 0
         self._spec_draft_ms = 0.0
         self._spec_verify_ms = 0.0
+        if self._pp > 1:
+            # the staged builders shadow the flat-stack methods on this
+            # instance, so everything downstream — the _fused_fn
+            # composition, warmup, prefill, step, the decode lint — picks
+            # up the pipeline schedule without knowing it exists
+            self._decode_fn = self._pp_decode_fn()
+            self._prefill_fn = self._pp_prefill_fn
+            self._suffix_fn = self._pp_suffix_fn
+            self._self_draft_fn = self._pp_self_draft_fn
+            self._verify_fn = self._pp_verify_fn
         if warmup:
             self.warmup()
 
@@ -666,6 +782,349 @@ class DecodeEngine:
         v_pool = v_pool.at[:, dst].set(v_pool[:, src])
         return k_pool, v_pool
 
+    # -- pipeline-parallel staged builders -----------------------------------
+    #
+    # With pp_axis active these closures SHADOW the flat-stack builders
+    # above (see __init__): same signatures, same AOT plumbing, but the body
+    # is a staged schedule inside the shard_map. Design rules:
+    #
+    # - no-cond: every stage executes every pass unconditionally, so no
+    #   collective ever sits under data-dependent control flow (GC-J107).
+    #   Only the stage whose turn it is KEEPS its block outputs
+    #   (jnp.where select) and writes real pages — inactive stages' KV
+    #   writes are redirected to scratch page 0, exactly like masked lanes.
+    # - activations hop stage -> stage on a ppermute ring between passes;
+    #   the final stage's head output publishes with a select-psum (every
+    #   other stage contributes zeros).
+    # - the pool's LAYERS axis is sharded over pp_axis, so ``attend``'s
+    #   ``layer`` argument is the stage-LOCAL block index — the model's
+    #   block_* helpers are called per block with that local index.
+
+    def _pp_stage(self, params):
+        """Per-shard view of the staged params inside a shard_map body:
+        ``(stage index, this stage's [layers/pp, ...] block leaves,
+        shared embed/final_ln)``."""
+        s = jax.lax.axis_index(self._pp_axis)
+        local = jax.tree.map(lambda a: a[0], params["stages"])
+        return s, local, params["shared"]
+
+    def _pp_decode_fn(self):
+        """Staged single-wave decode step: PP unrolled passes through the
+        ring, each pass running this stage's blocks (kept only when it is
+        the active stage). One token per slot per call — the wave tick
+        (:meth:`_pp_tick_fn`) is the bubble-free schedule on top of the
+        same per-stage body."""
+        model, page = self.model, self.page_size
+        bidx = jnp.arange(self.num_slots)
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def decode(params, k_pool, v_pool, token, pos, table, keys,
+                   temp, topk):
+            s, local, shared = self._pp_stage(params)
+            x = model.decode_embed(shared, token, pos)
+            for i in range(PP):
+                if i:
+                    x = jax.lax.ppermute(x, axis, perm)
+                active = s == i
+
+                def attend(layer, q, k_new, v_new, cache, p,
+                           _active=active):
+                    kp, vp = cache
+                    pids = jnp.where(_active, table[bidx, p // page], 0)
+                    off = p % page
+                    kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
+                    vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
+                    out = paged_attention(q, kp[layer], vp[layer], table,
+                                          p + 1)
+                    return out.astype(q.dtype), (kp, vp)
+
+                y = x
+                for jl in range(per):
+                    bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                    y, (k_pool, v_pool) = model.block_decode(
+                        bp, y, jl, (k_pool, v_pool), pos, attend,
+                        tp_axis=self._tp_axis)
+                x = jnp.where(active, y, x)
+            logits = model.decode_head(shared, x)
+            tok, keys = self._sample_tokens(logits, keys, temp, topk)
+            last = s == PP - 1
+            tok = jax.lax.psum(jnp.where(last, tok, 0), axis)
+            keys = jax.lax.psum(jnp.where(last, keys, 0), axis)
+            return tok, k_pool, v_pool, keys
+
+        return decode
+
+    def _pp_prefill_fn(self, bucket: int):
+        """Staged ladder prefill for one bucket: same ring schedule as
+        :meth:`_pp_decode_fn`, each stage committing only its own layers'
+        K/V into its layers-shard of the pool."""
+        model, page = self.model, self.page_size
+        npages = bucket // page
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def prefill(params, k_pool, v_pool, ids, length, page_ids):
+            s, local, shared = self._pp_stage(params)
+            x = model.prefill_embed(shared, ids)
+            for i in range(PP):
+                if i:
+                    x = jax.lax.ppermute(x, axis, perm)
+                active = s == i
+                pids = jnp.where(active, page_ids, 0)
+                y = x
+                for jl in range(per):
+                    bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                    y, k, v = model.block_prefill(bp, y,
+                                                  tp_axis=self._tp_axis)
+                    kk = jnp.transpose(k[0], (1, 0, 2)).reshape(
+                        npages, page, k.shape[1], k.shape[3])
+                    vv = jnp.transpose(v[0], (1, 0, 2)).reshape(
+                        npages, page, v.shape[1], v.shape[3])
+                    k_pool = k_pool.at[jl, pids].set(kk.astype(k_pool.dtype))
+                    v_pool = v_pool.at[jl, pids].set(vv.astype(v_pool.dtype))
+                x = jnp.where(active, y, x)
+            logits = model.head_last(shared, x, lengths=length)
+            logits = jax.lax.psum(
+                jnp.where(s == PP - 1, logits, 0.0), axis)
+            return logits, k_pool, v_pool
+
+        return prefill
+
+    def _pp_suffix_fn(self):
+        """Staged suffix prefill (see :meth:`_suffix_fn` for the chunk
+        semantics): the manual gather-attend runs per stage over its local
+        layers, pad AND inactive-stage writes both land in scratch."""
+        model, page, C = self.model, self.page_size, self._chunk_width
+        maxp = self.max_pages_per_slot
+        scale = 1.0 / math.sqrt(model.head_dim)
+        j = jnp.arange(C, dtype=jnp.int32)
+        tpos = jnp.arange(maxp * page, dtype=jnp.int32)
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def suffix_prefill(params, k_pool, v_pool, ids, start, valid, ctable):
+            s, local, shared = self._pp_stage(params)
+            x = model.suffix_embed(shared, ids, start)
+            for i in range(PP):
+                if i:
+                    x = jax.lax.ppermute(x, axis, perm)
+                active = s == i
+
+                def attend(layer, q, k_new, v_new, cache, st,
+                           _active=active):
+                    kp, vp = cache
+                    heads, hd = kp.shape[-2], kp.shape[-1]     # local heads
+                    pos_abs = st[0] + j
+                    pids = ctable[jnp.clip(pos_abs // page, 0, maxp - 1)]
+                    pids = jnp.where(j < valid[0], pids, 0)
+                    pids = jnp.where(_active, pids, 0)
+                    off = pos_abs % page
+                    kc = jnp.transpose(k_new[0], (1, 0, 2))
+                    vc = jnp.transpose(v_new[0], (1, 0, 2))
+                    kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
+                    vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
+                    hk = kp[layer, ctable].reshape(maxp * page, heads, hd)
+                    hv = vp[layer, ctable].reshape(maxp * page, heads, hd)
+                    sc = jnp.einsum("hcd,lhd->hcl",
+                                    q[0].astype(jnp.float32),
+                                    hk.astype(jnp.float32)) * scale
+                    ok = tpos[None, :] <= pos_abs[:, None]
+                    sc = jnp.where(ok[None, :, :], sc, -1e30)
+                    pr = jax.nn.softmax(sc, axis=-1)
+                    out = jnp.einsum("hcl,lhd->hcd", pr,
+                                     hv.astype(jnp.float32))
+                    return out[None].astype(q.dtype), (kp, vp)
+
+                y = x
+                for jl in range(per):
+                    bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                    y, (k_pool, v_pool) = model.block_suffix(
+                        bp, y, jl, (k_pool, v_pool), start, attend,
+                        tp_axis=self._tp_axis)
+                x = jnp.where(active, y, x)
+            logits = model.head_last(shared, x, lengths=valid)
+            logits = jax.lax.psum(
+                jnp.where(s == PP - 1, logits, 0.0), axis)
+            return logits, k_pool, v_pool
+
+        return suffix_prefill
+
+    def _pp_verify_fn(self):
+        """Staged speculative verify (see :meth:`_verify_fn`): one ring
+        traversal scoring all ``spec_k + 1`` chunk positions, greedy grid
+        and bonus sample published from the final stage."""
+        model, page, maxp = self.model, self.page_size, self.max_pages_per_slot
+        S = self.spec_k + 1
+        bidx = jnp.arange(self.num_slots)
+        j = jnp.arange(S, dtype=jnp.int32)
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def verify(params, k_pool, v_pool, ids, start, nvalid, table, keys,
+                   temp, topk):
+            s, local, shared = self._pp_stage(params)
+            x = model.suffix_embed(shared, ids, start)
+            for i in range(PP):
+                if i:
+                    x = jax.lax.ppermute(x, axis, perm)
+                active = s == i
+
+                def attend(layer, q, k_new, v_new, cache, st,
+                           _active=active):
+                    kp, vp = cache
+                    pos_abs = st[:, None] + j[None, :]
+                    pids = table[bidx[:, None],
+                                 jnp.clip(pos_abs // page, 0, maxp - 1)]
+                    pids = jnp.where(j[None, :] < nvalid[:, None], pids, 0)
+                    pids = jnp.where(_active, pids, 0)
+                    off = pos_abs % page
+                    kc = jnp.transpose(k_new, (0, 2, 1, 3))
+                    vc = jnp.transpose(v_new, (0, 2, 1, 3))
+                    kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
+                    vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
+                    out = paged_attention_verify(q, kp[layer], vp[layer],
+                                                 table, st)
+                    return out.astype(q.dtype), (kp, vp)
+
+                y = x
+                for jl in range(per):
+                    bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                    y, (k_pool, v_pool) = model.block_suffix(
+                        bp, y, jl, (k_pool, v_pool), start, attend,
+                        tp_axis=self._tp_axis)
+                x = jnp.where(active, y, x)
+            logits = model.head_all(shared, x)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            samp0, keys = self._sample_tokens(logits[:, 0], keys, temp, topk)
+            last = s == PP - 1
+            g = jax.lax.psum(jnp.where(last, g, 0), axis)
+            samp0 = jax.lax.psum(jnp.where(last, samp0, 0), axis)
+            keys = jax.lax.psum(jnp.where(last, keys, 0), axis)
+            return g, samp0, k_pool, v_pool, keys
+
+        return verify
+
+    def _pp_self_draft_fn(self):
+        """Staged self-speculation chain: ``draft_layers`` spans the first
+        ``draft_layers / (layers/pp)`` stages (validated at construction),
+        so each of the ``spec_k`` greedy steps traverses only that ring
+        prefix and the drafted token broadcasts back to every stage with a
+        select-psum before the next step embeds it."""
+        model, page, maxp = self.model, self.page_size, self.max_pages_per_slot
+        K, Ld = self.spec_k, self.draft_layers
+        bidx = jnp.arange(self.num_slots)
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        ds = Ld // per                      # stages the draft spans
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def draft(params, k_pool, v_pool, token, pos, table, nappend):
+            s, local, shared = self._pp_stage(params)
+            writable = pos + nappend        # first position with no room
+
+            toks, tok = [], token
+            for jk in range(K):
+                p = pos + jk
+                x = model.decode_embed(shared, tok, p)
+                for i in range(ds):
+                    if i:
+                        x = jax.lax.ppermute(x, axis, perm)
+                    active = s == i
+
+                    def attend(layer, q, k_new, v_new, cache, pq,
+                               _active=active):
+                        kp, vp = cache
+                        pids = table[bidx,
+                                     jnp.clip(pq // page, 0, maxp - 1)]
+                        pids = jnp.where(pq < writable, pids, 0)
+                        pids = jnp.where(_active, pids, 0)
+                        off = pq % page
+                        kp = kp.at[layer, pids, off].set(
+                            k_new.astype(kp.dtype))
+                        vp = vp.at[layer, pids, off].set(
+                            v_new.astype(vp.dtype))
+                        out = paged_attention(q, kp[layer], vp[layer],
+                                              table, pq + 1)
+                        return out.astype(q.dtype), (kp, vp)
+
+                    y = x
+                    for jl in range(per):
+                        bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                        y, (k_pool, v_pool) = model.block_decode(
+                            bp, y, jl, (k_pool, v_pool), p, attend,
+                            tp_axis=self._tp_axis)
+                    x = jnp.where(active, y, x)
+                logits = model.decode_head(shared, x)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jax.lax.psum(jnp.where(s == ds - 1, tok, 0), axis)
+                toks.append(tok)
+            return jnp.stack(toks, axis=1), k_pool, v_pool
+
+        return draft
+
+    def _pp_tick_fn(self):
+        """Micro-token wave tick: ONE pass per stage per call, every stage
+        busy on its OWN wave. At tick t stage s runs wave ``(t - s) mod pp``
+        — stage 0 embeds the entry wave's freshly appended tokens, every
+        other stage continues the activations that hopped in on the carry
+        ring last tick, and the final stage samples the exit wave. Wall
+        clock per tick is ~1/pp of the flat step, so a full pipeline emits
+        the same tokens/sec with no stage ever idle (bubble only at
+        drain/refill edges). One fixed-shape executable — tick index, wave
+        operands and the carry are all traced operands."""
+        model, page = self.model, self.page_size
+        PP, axis = self._pp, self._pp_axis
+        per = int(model.num_layers) // PP
+        W = self.num_slots // PP
+        widx = jnp.arange(W)
+        perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def tick(params, k_pool, v_pool, x_carry, t, token, pos, table,
+                 keys, temp, topk):
+            s, local, shared = self._pp_stage(params)
+            w = jnp.mod(t - s, PP)
+            o = w * W
+            tok_w = jax.lax.dynamic_slice_in_dim(token, o, W)
+            pos_w = jax.lax.dynamic_slice_in_dim(pos, o, W)
+            tab_w = jax.lax.dynamic_slice_in_dim(table, o, W, axis=0)
+            key_w = jax.lax.dynamic_slice_in_dim(keys, o, W, axis=0)
+            tmp_w = jax.lax.dynamic_slice_in_dim(temp, o, W)
+            tpk_w = jax.lax.dynamic_slice_in_dim(topk, o, W)
+            # stage 0 ingests its wave at the embed; later stages pick up
+            # where the carry ring left their wave last tick
+            x = jnp.where(s == 0,
+                          model.decode_embed(shared, tok_w, pos_w),
+                          x_carry[0])
+
+            def attend(layer, q, k_new, v_new, cache, p):
+                kp, vp = cache
+                pids = tab_w[widx, p // page]
+                off = p % page
+                kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
+                vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
+                out = paged_attention(q, kp[layer], vp[layer], tab_w, p + 1)
+                return out.astype(q.dtype), (kp, vp)
+
+            for jl in range(per):
+                bp = jax.tree.map(lambda a, _j=jl: a[_j], local)
+                x, (k_pool, v_pool) = model.block_decode(
+                    bp, x, jl, (k_pool, v_pool), pos_w, attend,
+                    tp_axis=self._tp_axis)
+            logits = model.decode_head(shared, x)
+            tok, key = self._sample_tokens(logits, key_w, tmp_w, tpk_w)
+            last = s == PP - 1
+            tok = jax.lax.psum(jnp.where(last, tok, 0), axis)
+            key = jax.lax.psum(jnp.where(last, key, 0), axis)
+            x_next = jax.lax.ppermute(x, axis, perm)
+            return tok, key, k_pool, v_pool, x_next[None]
+
+        return tick
+
     def _param_struct(self):
         return jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
@@ -775,6 +1234,24 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((B,), i32)),
                     specs=(psp, pls, pls, R, R, R, R, R, R, R, R, R, R),
                     out_specs=(R, R, pls, pls, R))
+            self.aot_compiles += 1
+        if self._pp_wave and self._tick_exe is None:
+            xc = jax.ShapeDtypeStruct(self._x_carry.shape,
+                                      self._x_carry.dtype)
+            pcar = P(self._pp_axis)
+            with annotate("serving/decode_compile_wave_tick"):
+                self._tick_exe = self._aot(
+                    self._pp_tick_fn(), (1, 2, 3),
+                    (ps, pool, pool, xc,
+                     jax.ShapeDtypeStruct((), i32),
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B,), i32),
+                     jax.ShapeDtypeStruct((B, maxp), i32),
+                     jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                     jax.ShapeDtypeStruct((B,), jnp.float32),
+                     jax.ShapeDtypeStruct((B,), i32)),
+                    specs=(psp, pls, pls, pcar, R, R, R, R, R, R, R),
+                    out_specs=(R, R, pls, pls, pcar))
             self.aot_compiles += 1
         if self.spec_k:
             self._warmup_spec_locked(ps, pool, B, maxp)
@@ -1003,17 +1480,34 @@ class DecodeEngine:
         committed contributes its *first* token to the result. While a
         chunk is pending the speculative path stands down for the iteration
         (plain fused step) so the chunk work stays fused with decode. No-op
-        (empty dict) when nothing is active."""
+        (empty dict) when nothing is active.
+
+        With wave scheduling on (``pp_wave`` under a pp mesh) each call is
+        one pipeline *tick*: roughly ``1/pp`` of the slots emit a token per
+        call and a slot's next token arrives ``pp`` ticks after its entry —
+        same steady-state tokens/sec, every stage busy. Pending chunked
+        prefills drain the pipeline first, then run the flat fused call."""
         with self._lock:
             active = self.kv.active_slots()
             ready = np.asarray([int(s) for s in active
                                 if self._decode_ready[s]], np.int64)
             state = self._pending[0] if self._pending else None
+            if self._pp_wave and state is None:
+                return self._wave_step_locked(ready)
             if ready.size == 0 and state is None:
                 return {}
             if self.spec_k and state is None:
                 return self._spec_step_locked(ready)
             t0 = time.perf_counter()
+            pre: Dict[int, List[int]] = {}
+            if self._pp_wave:
+                # the fused chunk call runs the flat (single-wave) staged
+                # schedule: quiesce the wave pipeline first so every
+                # in-flight token lands before new page room is appended
+                pre = self._drain_waves_locked()
+                ready = np.asarray(
+                    [int(s) for s in self.kv.active_slots()
+                     if self._decode_ready[s]], np.int64)
             # the incoming token occupies position == current length: make
             # sure its page exists, then pass the PRE-append position
             for s in ready:
@@ -1095,6 +1589,88 @@ class DecodeEngine:
                                  int(ready.size))
             self.metrics.observe("serving/decode/token_latency_ms",
                                  dt_ms)  # per-token: one step = one token
+            if pre:
+                # tokens harvested while draining the wave pipeline precede
+                # this step's token for the same slot
+                for sl, ts in pre.items():
+                    out[sl] = ts + out.get(sl, [])
+        return out
+
+    def _wave_step_locked(self, ready: np.ndarray) -> Dict[int, List[int]]:
+        """One wave tick: admit this tick's entry wave (append page room for
+        its ready slots), run the staged tick executable — every stage busy
+        on its own wave — and harvest the exit wave. A slot's wave is fixed
+        by its lane index (``slot // (num_slots/pp)``), so a freshly
+        admitted slot waits at most ``pp - 1`` ticks for its entry turn."""
+        inflight = any(self._wave_inflight[w] for w in range(self._pp))
+        if ready.size == 0 and not inflight:
+            return {}
+        t0 = time.perf_counter()
+        W = self.num_slots // self._pp
+        wn = self._tick % self._pp
+        entry = [int(s) for s in ready if wn * W <= int(s) < (wn + 1) * W]
+        for s in entry:
+            self.kv.append(s)
+        self._wave_inflight[wn] = entry
+        out = self._run_tick_locked()
+        self._steps += 1
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe("serving/decode/step_ms", dt_ms)
+        self.metrics.observe("serving/decode/step_active", int(ready.size))
+        for _ in out:
+            self.metrics.observe("serving/decode/token_latency_ms", dt_ms)
+        return out
+
+    def _run_tick_locked(self) -> Dict[int, List[int]]:
+        """Run one tick of the staged wave executable over the current
+        in-flight waves and harvest the exiting one. Operand rebuild is
+        safe mid-flight: a slot's length/table/token only change at its own
+        entry tick (append) or harvest (sample), never in between."""
+        B = self.num_slots
+        inflight = sorted({s for lst in self._wave_inflight.values()
+                           for s in lst})
+        mask = np.zeros(B, bool)
+        mask[inflight] = True
+        lengths = self.kv.lengths()
+        table_full = self.kv.page_tables()
+        pos = np.maximum(lengths - 1, 0).astype(np.int32)
+        pos[~mask] = 0
+        table = table_full.copy()
+        table[~mask] = 0
+        token = np.where(mask, self._last_token, 0).astype(np.int32)
+        with obs_span("serving/decode_wave_tick",
+                      args={"tick": int(self._tick),
+                            "inflight": len(inflight)},
+                      jax_annotation=True):
+            tok, keys, self._k_pool, self._v_pool, self._x_carry = \
+                self._tick_exe(self._params, self._k_pool, self._v_pool,
+                               self._x_carry,
+                               np.int32(self._tick % self._pp), token, pos,
+                               table, self._keys, self._temp, self._topk)
+        we = (self._tick - (self._pp - 1)) % self._pp
+        self._tick += 1
+        exit_slots = self._wave_inflight[we]
+        self._wave_inflight[we] = []
+        out: Dict[int, List[int]] = {}
+        if exit_slots:
+            tok = np.asarray(tok)
+            keys = np.asarray(keys)
+            W = self.num_slots // self._pp
+            for s in exit_slots:
+                r = s - we * W
+                self._last_token[s] = tok[r]
+                self._keys[s] = keys[r]
+                out[s] = [int(tok[r])]
+            self._tokens_out += len(exit_slots)
+        return out
+
+    def _drain_waves_locked(self) -> Dict[int, List[int]]:
+        """Tick the pipeline with no new entries until every in-flight wave
+        has harvested (at most ``pp - 1`` ticks)."""
+        out: Dict[int, List[int]] = {}
+        while any(self._wave_inflight[w] for w in range(self._pp)):
+            for s, ts in self._run_tick_locked().items():
+                out.setdefault(s, []).extend(ts)
         return out
 
     def _spec_step_locked(self, ready: np.ndarray) -> Dict[int, List[int]]:
@@ -1209,6 +1785,12 @@ class DecodeEngine:
             self.kv.free(int(slot))
             self._pending = [st for st in self._pending
                              if st["slot"] != int(slot)]
+            # scrub any in-flight wave entry: if the lane is re-admitted
+            # before that wave exits, its stale token must not surface into
+            # the new request's stream
+            for w in self._wave_inflight:
+                self._wave_inflight[w] = [
+                    s for s in self._wave_inflight[w] if s != int(slot)]
             self._decode_ready[slot] = False
             self._last_token[slot] = 0
             self._temp[slot] = 0.0
@@ -1258,6 +1840,10 @@ class DecodeEngine:
                              if self.mesh is not None else None),
                     "tp": self._tp,
                     "ep": self._ep,
+                    "pp": self._pp,
+                    "stages": self._pp,
+                    "pp_wave": self._pp_wave,
+                    "wave_ticks": self._tick,
                     "kv_bytes_per_device": (
                         per_device_bytes(self._k_pool)
                         + per_device_bytes(self._v_pool)),

@@ -125,6 +125,15 @@ class InferenceEngine:
             # fail on a typo'd axis at construction, not first request; a
             # mesh without the data axis is fine (rows replicate)
             self.sharding.validate(self.mesh, require_data_axis=False)
+            if (self.sharding.pp_axis is not None
+                    and int(self.mesh.shape.get(self.sharding.pp_axis, 1))
+                    > 1):
+                raise ValueError(
+                    "pp_axis is a decode-plane axis: the single-shot "
+                    "predict engine has no token cadence to hide pipeline "
+                    "bubbles behind. Serve depth-sharded models through "
+                    "DecodeEngine (serving/decode.py), or drop pp_axis "
+                    "from this engine's sharding config.")
         self.quantize = quantize
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
 

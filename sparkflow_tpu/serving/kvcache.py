@@ -15,7 +15,11 @@ This class is the **host-side bookkeeper**: free-page list, per-slot tables
 and lengths, allocation/append/free at token granularity. The actual K/V
 arrays live on-device inside :class:`~sparkflow_tpu.serving.decode.DecodeEngine`'s
 donated state pytree; the manager just hands the engine ``page_table`` /
-``lengths`` operands each step.
+``lengths`` operands each step. The bookkeeping is device-layout-blind: a
+page id names the same ``[page_size, heads, head_dim]`` block of every
+layer, whether the pool lives on one chip or shards its heads axis over a
+tp mesh / its layers axis over a pp mesh — refcounts, the prefix trie and
+COW never change when the engine re-lays the pool out.
 
 Admission is reservation-based: :meth:`alloc` checks that the request's
 **worst case** (prompt + max_new_tokens) fits in free pages before admitting,

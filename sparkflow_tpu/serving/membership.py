@@ -179,13 +179,16 @@ class Replica:
         # speculative-decode acceptance rate from /healthz; -1 = speculation
         # off on the replica (or not yet probed)
         self.decode_spec_accept_rate = -1.0
-        # model-parallel layout from /healthz's "decode" block: tp/ep degree
-        # and the replica's mesh axis sizes. tp/ep default to 1 (a replica
-        # without a decode plane is effectively unsharded); mesh_shape is
-        # None until a probe reports one.
+        # model-parallel layout from /healthz's "decode" block: tp/ep/pp
+        # degree and the replica's mesh axis sizes. tp/ep/pp default to 1
+        # (a replica without a decode plane is effectively unsharded);
+        # mesh_shape is None until a probe reports one. pp == stages: the
+        # replica's pipeline depth, exported so capacity math knows its
+        # per-device KV bytes are 1/pp of the replica total.
         self.mesh_shape: Optional[Dict[str, int]] = None
         self.tp = 1
         self.ep = 1
+        self.pp = 1
         self.successes = 0
         self.failures = 0
         self.hedges = 0              # hedge requests sent to this replica
@@ -282,6 +285,7 @@ class Membership:
                                           else None)
                     replica.tp = int(dec.get("tp", 1) or 1)
                     replica.ep = int(dec.get("ep", 1) or 1)
+                    replica.pp = int(dec.get("pp", 1) or 1)
                 else:
                     replica.decode_free_slots = -1
                     replica.decode_pages_free = -1
@@ -289,6 +293,7 @@ class Membership:
                     replica.mesh_shape = None
                     replica.tp = 1
                     replica.ep = 1
+                    replica.pp = 1
         if ok:
             # a live /healthz is recovery evidence: without it an ejected
             # replica on an idle fleet stays OPEN forever, because half-open
@@ -400,7 +405,7 @@ class Membership:
                          decode_free_slots=r.decode_free_slots,
                          decode_pages_free=r.decode_pages_free,
                          decode_spec_accept_rate=r.decode_spec_accept_rate,
-                         mesh_shape=r.mesh_shape, tp=r.tp, ep=r.ep,
+                         mesh_shape=r.mesh_shape, tp=r.tp, ep=r.ep, pp=r.pp,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
                     for r in self._replicas]
@@ -412,7 +417,7 @@ class Membership:
     def publish_gauges(self) -> None:
         """Export the fleet table as Prometheus gauges:
         ``router/replica<i>/{healthy,ejected,inflight,error_rate,hedges,
-        kv_pages_free,spec_accept_rate,tp,ep}``."""
+        kv_pages_free,spec_accept_rate,tp,ep,pp}``."""
         for row in self.snapshot():
             prefix = f"router/replica{row['index']}"
             total = row["successes"] + row["failures"]
@@ -430,6 +435,8 @@ class Membership:
                                float(row["decode_spec_accept_rate"]))
             # model-parallel degrees: a fleet dashboard reading capacity off
             # pages_free needs to know pages are per-replica (sharded over
-            # tp devices), and a mixed tp=1/tp=2 rollout shows up here
+            # tp heads / pp layers), and a mixed tp=1/tp=2 or pp=1/pp=2
+            # rollout shows up here
             self.metrics.gauge(f"{prefix}/tp", float(row["tp"]))
             self.metrics.gauge(f"{prefix}/ep", float(row["ep"]))
+            self.metrics.gauge(f"{prefix}/pp", float(row["pp"]))

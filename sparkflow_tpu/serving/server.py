@@ -404,6 +404,8 @@ class InferenceServer:
                 "mesh_shape": par.get("mesh"),
                 "tp": int(par.get("tp", 1) or 1),
                 "ep": int(par.get("ep", 1) or 1),
+                "pp": int(par.get("pp", 1) or 1),
+                "stages": int(par.get("stages", 1) or 1),
                 "engine": gstats,
             }
             spec = gstats.get("spec", {}) if isinstance(gstats, dict) else {}

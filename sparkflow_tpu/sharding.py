@@ -39,12 +39,14 @@ now consume:
   gather per step (docs/sharding.md).
 - ``offload_opt_state`` — park optimizer state in host memory between
   steps (models whose state exceeds HBM even at 1/dp).
-- ``tp_axis`` / ``ep_axis`` — model-parallel axes for the serving plane
-  (and any GSPMD program that wants them by name): ``tp_axis`` shards
-  attention heads / MLP hidden per megatron rules and the paged KV pool on
-  its heads dimension; ``ep_axis`` shards MoE expert banks. A serving
-  replica with either set is a mesh, not a device — the wire protocol is
-  unchanged (docs/serving.md).
+- ``tp_axis`` / ``ep_axis`` / ``pp_axis`` — model-parallel axes for the
+  serving plane (and any GSPMD program that wants them by name):
+  ``tp_axis`` shards attention heads / MLP hidden per megatron rules and
+  the paged KV pool on its heads dimension; ``ep_axis`` shards MoE expert
+  banks; ``pp_axis`` splits the transformer depth-wise into pipeline
+  stages (``parallel/pp.py`` stage layout) and shards the paged KV pool on
+  its LAYERS dimension. A serving replica with any of them set is a mesh,
+  not a device — the wire protocol is unchanged (docs/serving.md).
 
 Import discipline: this module imports only jax — ``core``, ``trainer``,
 ``parallel/*``, ``serving`` and ``analysis`` all import it, never the
@@ -74,6 +76,7 @@ class ShardingConfig:
     offload_opt_state: bool = False
     tp_axis: Optional[str] = None
     ep_axis: Optional[str] = None
+    pp_axis: Optional[str] = None
 
     def __post_init__(self):
         if self.zero_stage not in ZERO_STAGES:
@@ -92,7 +95,7 @@ class ShardingConfig:
                 f"than data_axis={self.data_axis!r}: the two-level reduction "
                 f"needs a distinct slow (cross-slice) axis next to the fast "
                 f"ICI one")
-        for field in ("tp_axis", "ep_axis"):
+        for field in ("tp_axis", "ep_axis", "pp_axis"):
             ax = getattr(self, field)
             if ax is None:
                 continue
@@ -105,10 +108,14 @@ class ShardingConfig:
                     f"{field}={ax!r} must name a DIFFERENT mesh axis than "
                     f"data_axis/dcn_axis: model-parallel shards live "
                     f"orthogonal to the batch axes")
-        if self.tp_axis is not None and self.tp_axis == self.ep_axis:
-            raise ValueError(
-                f"tp_axis and ep_axis both name {self.tp_axis!r}: head/hidden "
-                f"shards and expert shards need distinct mesh axes")
+        model_axes = [("tp_axis", self.tp_axis), ("ep_axis", self.ep_axis),
+                      ("pp_axis", self.pp_axis)]
+        for i, (fa, va) in enumerate(model_axes):
+            for fb, vb in model_axes[i + 1:]:
+                if va is not None and va == vb:
+                    raise ValueError(
+                        f"{fa} and {fb} both name {va!r}: tp/ep/pp need "
+                        f"distinct mesh axes")
 
     # -- validation ---------------------------------------------------------
 
@@ -137,7 +144,7 @@ class ShardingConfig:
             raise ValueError(
                 f"dcn_axis={self.dcn_axis!r} is not a mesh axis "
                 f"{list(mesh.axis_names)}")
-        for field in ("tp_axis", "ep_axis"):
+        for field in ("tp_axis", "ep_axis", "pp_axis"):
             ax = getattr(self, field)
             if ax is not None and ax not in mesh.axis_names:
                 # a typo'd model axis would silently replicate the weights
@@ -199,9 +206,16 @@ class ShardingConfig:
             return 1
         return int(mesh.shape.get(self.ep_axis, 1))
 
+    def pp_size(self, mesh: Mesh) -> int:
+        """Pipeline-parallel depth on this mesh (1 when unset/absent)."""
+        if self.pp_axis is None:
+            return 1
+        return int(mesh.shape.get(self.pp_axis, 1))
+
     def model_parallel(self) -> bool:
         """True when this config asks for any model-parallel axis."""
-        return self.tp_axis is not None or self.ep_axis is not None
+        return (self.tp_axis is not None or self.ep_axis is not None
+                or self.pp_axis is not None)
 
     def describe(self) -> dict:
         """Flat dict for logs / ``stats()`` / the graftcheck lint."""
@@ -214,6 +228,7 @@ class ShardingConfig:
             "offload_opt_state": self.offload_opt_state,
             "tp_axis": self.tp_axis,
             "ep_axis": self.ep_axis,
+            "pp_axis": self.pp_axis,
         }
 
     def replace(self, **kw) -> "ShardingConfig":

@@ -583,26 +583,55 @@ class Trainer:
         return step_fn
 
     def _wrap_offload(self, epoch_fn, opt_shardings):
-        """``sharding.offload_opt_state=True``: the optimizer state lives on
-        HOST between epoch calls — device_put with its shardings just before
-        the program runs (so donation still sees correctly-placed buffers)
-        and device_get right after. Trades a PCIe round-trip per epoch for
-        the state's bytes of device memory; only the loop path supports it
-        (the fused multi-epoch program never returns to the host)."""
+        """``sharding.offload_opt_state=True``: the optimizer state's home is
+        host memory — double-buffered, not synchronous. The old wrapper
+        serialized ``device_put → step → device_get`` every call, stalling
+        the loop on a PCIe round-trip per step. Now the first call (and any
+        call handed a host tree, e.g. after a checkpoint restore) uploads
+        with the step's shardings so donation still sees correctly-placed
+        buffers; steady-state calls recognize their own returned device tree
+        and skip the re-upload entirely. The device→host copy of step t's
+        updated state is *enqueued* right after the (async-dispatched) step
+        program, so it completes behind step t+1's compute — checkpoint
+        saves, preemption and :meth:`_flush_opt_state` then find the bytes
+        already host-side instead of paying the transfer at the sync point.
+        Numerics are untouched: no value ever round-trips through a lossy
+        path, so losses are bitwise-equal to the on-device run. Only the
+        loop paths support it (the fused multi-epoch program never returns
+        to the host)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         repl = (NamedSharding(self.mesh, P())
                 if self.mesh is not None else None)
+        last = {"dev": None}
 
         def wrapped(params, opt_state, *rest):
-            place = opt_shardings if opt_shardings is not None else (
-                jax.tree.map(lambda _: repl, opt_state) if repl is not None
-                else None)
-            if place is not None:
-                opt_state = jax.tree.map(jax.device_put, opt_state, place)
+            if opt_state is not last["dev"]:
+                # cold path: first call, or a restore handed us a host tree
+                place = opt_shardings if opt_shardings is not None else (
+                    jax.tree.map(lambda _: repl, opt_state)
+                    if repl is not None else None)
+                if place is not None:
+                    opt_state = jax.tree.map(jax.device_put, opt_state,
+                                             place)
             params, opt_state, losses = epoch_fn(params, opt_state, *rest)
-            return params, jax.device_get(opt_state), losses
+            # enqueue the D2H copy NOW: it drains while the caller
+            # dispatches the next step, not when someone blocks on it
+            for leaf in jax.tree.leaves(opt_state):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            last["dev"] = opt_state
+            return params, opt_state, losses
 
         return wrapped
+
+    def _flush_opt_state(self, opt_state):
+        """Offload runs keep the working opt state device-resident between
+        steps (the host mirror refreshes asynchronously via
+        ``copy_to_host_async``); materialize concrete host arrays at the
+        points where the state outlives the loop (``_last_opt_state``)."""
+        if not self._offload_active or opt_state is None:
+            return opt_state
+        return jax.tree.map(np.asarray, opt_state)
 
     def _params_to_ckpt(self, params):
         """Checkpoints (and ``self.params`` / TrainResult) always hold the
@@ -1054,7 +1083,8 @@ class Trainer:
         k = total_epochs - start_epoch
         # span tracing joins the needs-per-epoch-host-control set: the fused
         # program is one opaque dispatch with no step boundaries to time
-        # (and opt-state offload needs the host hop between epoch calls)
+        # (and opt-state offload needs the per-epoch call boundary to
+        # refresh its host mirror)
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
                 and not self.halt_on_nan and stats is None
@@ -1296,7 +1326,7 @@ class Trainer:
             params = merge_stage_params(self.model, params)
         params = self._params_to_ckpt(params)
         self.params = params
-        self._last_opt_state = opt_state
+        self._last_opt_state = self._flush_opt_state(opt_state)
         epoch_keys = sorted(loss_by_it)
         epoch_losses = [float(loss_by_it[k]) for k in epoch_keys]
         if not nan_halted:  # the halt already logged its own ERROR
@@ -1521,8 +1551,9 @@ class Trainer:
                                    infer_params=pspecs is not None,
                                    sharding=self.sharding)
         if self._offload_active:
-            # streaming: the opt state hops host<->device around EVERY step
-            # (there is no fused program to amortize over)
+            # streaming: per-step double-buffered offload — the host mirror
+            # refreshes behind each step's compute instead of a synchronous
+            # hop around every step
             step = self._wrap_offload(step, opt_shardings)
 
         ckpt_mgr = None
@@ -1674,7 +1705,7 @@ class Trainer:
         wall = time.perf_counter() - t0
         params = self._params_to_ckpt(params)
         self.params = params
-        self._last_opt_state = opt_state
+        self._last_opt_state = self._flush_opt_state(opt_state)
         step_losses = [float(l) for l in losses]
         if not nan_halted:  # the halt already logged its own ERROR
             self._warn_non_finite(step_losses)

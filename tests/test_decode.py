@@ -1333,6 +1333,250 @@ def test_decode_lint_repo_clean(engine, engine_tp):
     assert jaxpr_lint.lint_decode_step(engine_tp) == []
 
 
+# -- pipeline-parallel decode: stage-sharded pool + wave scheduling -----------
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+    return make_mesh({"pp": 2}, devices=jax.devices()[:2])
+
+
+@pytest.fixture(scope="module")
+def engine_pp(lm, pp_mesh):
+    """Stage-sharded engine with speculation AND chunked prefill on. spec_k
+    forces the single-wave schedule (the verify chunk already amortizes
+    depth), so this fixture exercises the staged ladder/suffix/draft/verify
+    programs."""
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, spec_k=3, mesh=pp_mesh,
+                       sharding=ShardingConfig(pp_axis="pp"))
+
+
+@pytest.fixture(scope="module")
+def engine_pp_wave(lm, pp_mesh):
+    """Wave-scheduled pp engine: no speculation, so the micro-token wave
+    tick carries steady-state decode (chunked prefill still on — admission
+    drains the waves around each fused chunk step)."""
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, mesh=pp_mesh,
+                       sharding=ShardingConfig(pp_axis="pp"))
+
+
+def test_pp_greedy_parity_battery(engine_pp, lm):
+    """pp=2 greedy decode is token-identical to the dense forward across a
+    plain prompt, a prefix-publishing prompt, a chunked-admission prompt,
+    and a prefix-COW replay — speculation on throughout (single-wave
+    schedule), zero steady-state retraces."""
+    model, params = lm
+    sysp = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13, 12, 10]
+    prompts = [[5, 2, 8],            # plain short
+               sysp + [17, 18],      # publishes the shared prefix blocks
+               list(range(1, 25))]   # 24 tokens: chunked admission
+    for p in prompts:
+        toks, _ = _engine_greedy(engine_pp, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6)
+    # replay: COW prefix hit on the *layers-sharded* pool + speculation
+    toks, info = _engine_greedy(engine_pp, sysp + [17, 18], 6)
+    assert info["shared_tokens"] == 8
+    assert toks == _dense_greedy(model, params, sysp + [17, 18], 6)
+    st = engine_pp.stats()
+    assert st["steady_traces"] == 0, (
+        f"pipeline-parallel decode retraced after warmup: {st}")
+    assert st["spec"]["steps"] > 0
+    assert engine_pp.kv.stats()["prefix_hits"] >= 1
+    par = st["parallel"]
+    assert par["pp"] == 2 and par["stages"] == 2 and par["tp"] == 1
+    assert par["mesh"] == {"pp": 2}
+    assert par["pp_wave"] is False  # spec_k stands the waves down
+
+
+def test_pp_wave_concurrent_parity(engine_pp_wave, lm):
+    """Micro-token wave scheduling: four mixed-length slots fill both
+    waves of the pipeline, every stream stays token-identical to the dense
+    forward, and a chunked admission mid-decode drains/refills the waves
+    without disturbing in-flight streams. One tick executable, zero
+    steady-state retraces."""
+    model, params = lm
+    eng = engine_pp_wave
+    prompts = [[5, 2, 8], [1, 2, 3, 4, 5, 6, 7], [9], [4, 4]]
+    refs = [_dense_greedy(model, params, p, 5) for p in prompts]
+    infos = [eng.prefill(p, max_new_tokens=5, temperature=0.0)
+             for p in prompts]
+    got = {i["slot"]: [i["token"]] for i in infos}
+    guard = 0
+    while any(len(v) < 5 for v in got.values()):
+        for s, ts in eng.step().items():
+            got[s].extend(ts)
+        guard += 1
+        assert guard < 300, "wave decode made no progress"
+    for info, p, ref in zip(infos, prompts, refs):
+        assert got[info["slot"]][:5] == ref, p
+        eng.release(info["slot"])
+    # chunked admission while a wave stream decodes: the fused chunk step
+    # drains the in-flight waves, runs flat, and the waves refill after
+    long_p = list(range(2, 27))
+    info_a = eng.prefill([5, 2, 8], max_new_tokens=8, temperature=0.0)
+    info_b = eng.prefill(long_p, max_new_tokens=4, temperature=0.0)
+    assert info_b["chunked"] and info_b["token"] is None
+    got_a, got_b = [info_a["token"]], []
+    guard = 0
+    while len(got_a) < 8 or len(got_b) < 4:
+        r = eng.step()
+        got_a.extend(r.get(info_a["slot"], []))
+        got_b.extend(r.get(info_b["slot"], []))
+        guard += 1
+        assert guard < 500
+    eng.release(info_a["slot"])
+    eng.release(info_b["slot"])
+    assert got_a[:8] == _dense_greedy(model, params, [5, 2, 8], 8)
+    assert got_b[:4] == _dense_greedy(model, params, long_p, 4)
+    st = eng.stats()
+    assert st["steady_traces"] == 0, st
+    par = st["parallel"]
+    assert par["pp_wave"] is True and par["wave_ticks"] > 0
+
+
+def test_pp_wave_sampling_reproducible(engine_pp_wave):
+    """Same seed -> same sampled path through the wave tick plane (the
+    exit-wave logits ride the same select-psum as greedy)."""
+
+    def run():
+        info = engine_pp_wave.prefill([4, 4], max_new_tokens=4,
+                                      temperature=1.0, top_k=8, seed=123)
+        toks = [] if info["token"] is None else [info["token"]]
+        while len(toks) < 4:
+            out = engine_pp_wave.step()
+            if info["slot"] in out:
+                toks.extend(out[info["slot"]])
+        engine_pp_wave.release(info["slot"])
+        return toks[:4]
+
+    t1, t2 = run(), run()
+    assert t1 == t2
+    assert all(0 <= t < VOCAB for t in t1)
+    assert engine_pp_wave.stats()["steady_traces"] == 0
+
+
+def test_pp_at_rest_bytes_halved(engine_pp, engine_spec):
+    """Sharding the pool on its layers axis halves the at-rest KV bytes
+    per device exactly (same global shape, pp-way split on layers); the
+    stage-stacked params shrink too. engine_spec is the identical
+    construction minus the mesh."""
+    sh = engine_pp.stats()["parallel"]
+    ref = engine_spec.stats()["parallel"]
+    assert ref["pp"] == 1 and sh["pp"] == 2
+    assert sh["kv_bytes_per_device"] * 2 == ref["kv_bytes_per_device"], (
+        sh, ref)
+    assert sh["param_bytes_per_device"] < ref["param_bytes_per_device"]
+
+
+def test_pp_tp_mesh_composition_parity(lm):
+    """A 2D pp x tp mesh composes: depth-sharded stages whose blocks are
+    also width-sharded serve token-identical greedy output, and per-device
+    KV bytes drop by the full pp*tp factor."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    model, params = lm
+    mesh2d = make_mesh({"pp": 2, "tp": 2}, devices=jax.devices()[:4])
+    eng = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       mesh=mesh2d,
+                       sharding=ShardingConfig(pp_axis="pp", tp_axis="tp"))
+    ref_eng = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0)
+    for p in ([5, 2, 8], [1, 2, 3, 4, 5, 6, 7]):
+        toks, _ = _engine_greedy(eng, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6)
+    st = eng.stats()
+    assert st["steady_traces"] == 0
+    par, ref = st["parallel"], ref_eng.stats()["parallel"]
+    assert par["pp"] == 2 and par["tp"] == 2
+    assert par["mesh"] == {"pp": 2, "tp": 2}
+    assert par["kv_bytes_per_device"] * 4 == ref["kv_bytes_per_device"], (
+        par, ref)
+
+
+def test_pp_ctor_validation(lm, pp_mesh):
+    """pp misconfigurations surface at construction, before any compile:
+    ragged stage depth, indivisible wave lanes, pp+ep composition, a
+    draft chain that exits mid-stage, and the predict plane's refusal."""
+    model, params = lm
+    spec3 = build_registry_spec("transformer_lm", vocab_size=VOCAB,
+                                hidden=32, num_layers=3, num_heads=4,
+                                mlp_dim=64, max_len=32, dropout=0.0)
+    m3 = model_from_json(spec3)
+    with pytest.raises(ValueError, match="num_layers"):
+        DecodeEngine(m3, m3.init(jax.random.PRNGKey(0)), num_slots=2,
+                     page_size=8, mesh=pp_mesh,
+                     sharding=ShardingConfig(pp_axis="pp"), warmup=False)
+    with pytest.raises(ValueError, match="num_slots"):
+        DecodeEngine(model, params, num_slots=3, page_size=8, mesh=pp_mesh,
+                     sharding=ShardingConfig(pp_axis="pp"), warmup=False)
+    # draft_layers=1 is a whole stage here (stage depth 1): must pass the
+    # gate; an over-deep model with stage depth 2 and draft_layers=1 is the
+    # planted failure
+    spec4 = build_registry_spec("transformer_lm", vocab_size=VOCAB,
+                                hidden=32, num_layers=4, num_heads=4,
+                                mlp_dim=64, max_len=32, dropout=0.0)
+    m4 = model_from_json(spec4)
+    with pytest.raises(ValueError, match="stage boundary"):
+        DecodeEngine(m4, m4.init(jax.random.PRNGKey(0)), num_slots=4,
+                     page_size=8, mesh=pp_mesh,
+                     sharding=ShardingConfig(pp_axis="pp"),
+                     spec_k=2, draft_layers=1, warmup=False)
+    if len(jax.devices()) >= 4:
+        mspec = presets.moe_lm(VOCAB, hidden=32, num_layers=2, num_heads=4,
+                               mlp_dim=64, max_len=32, num_experts=4,
+                               moe_every=1)
+        moe = model_from_json(mspec)
+        mesh_ppep = make_mesh({"pp": 2, "ep": 2}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="does not compose"):
+            DecodeEngine(moe, moe.init(jax.random.PRNGKey(1)), num_slots=2,
+                         page_size=8, mesh=mesh_ppep,
+                         sharding=ShardingConfig(pp_axis="pp", ep_axis="ep"),
+                         warmup=False)
+    with pytest.raises(ValueError, match="pp_axis"):
+        InferenceEngine(model, params, input_name="input_ids:0",
+                        output_name="logits:0", max_batch=4, mesh=pp_mesh,
+                        sharding=ShardingConfig(pp_axis="pp"))
+
+
+def test_decode_lint_pp_planted_defects_both_directions(pp_mesh):
+    """The pp direction of GC-J106: a declared pp axis whose step has no
+    ppermute handoff (an exit psum alone is not a pipeline), and a rogue
+    ppermute on an engine that declares no pp_axis."""
+    x = jnp.ones((4,), jnp.float32)
+
+    def no_handoff(v):
+        # the exit broadcast without the stage handoff: pp joins the
+        # declared reduce axes, so ONLY the missing-ppermute finding fires
+        return jax.lax.psum(v, "pp")
+
+    found = jaxpr_lint.lint_decode_collectives(
+        no_handoff, (x,), mesh=pp_mesh, in_specs=(P(),), out_specs=P(),
+        pp_axis="pp")
+    assert len(found) == 1 and found[0].rule == "GC-J106", found
+    assert "ppermute" in found[0].message
+
+    def rogue(v):
+        return jax.lax.ppermute(v, "pp", [(0, 1), (1, 0)])
+
+    found = jaxpr_lint.lint_decode_collectives(
+        rogue, (x,), mesh=pp_mesh, in_specs=(P(),), out_specs=P())
+    assert any(f.rule == "GC-J106" and "depth-sharded" in f.message
+               for f in found), found
+
+
+def test_decode_lint_pp_repo_clean(engine_pp, engine_pp_wave):
+    """The repo's own staged decode step passes the pp lint: the declared
+    pp axis shows its ppermute handoff, and the exit psums over pp are
+    recognized as declared rather than rogue."""
+    assert jaxpr_lint.lint_decode_step(engine_pp) == []
+    assert jaxpr_lint.lint_decode_step(engine_pp_wave) == []
+
+
 # -- static gates -------------------------------------------------------------
 
 

@@ -331,16 +331,16 @@ def test_spec_decode_gauges_prometheus_exposition():
 
 
 def test_fleet_model_parallel_gauges_prometheus_exposition():
-    """The router's per-replica model-parallel gauges (tp/ep degree from
+    """The router's per-replica model-parallel gauges (tp/ep/pp degree from
     each replica's /healthz decode block) land in the Prometheus text —
-    a mixed tp=1/tp=2 rollout is visible per replica."""
+    a mixed tp=1/tp=2 or pp=1/pp=2 rollout is visible per replica."""
     from sparkflow_tpu.serving.membership import Membership
     m = Metrics()
     mem = Membership(["http://127.0.0.1:1", "http://127.0.0.1:2"], metrics=m)
     bodies = [
         {"status": "ok", "queue_depth": 0, "in_flight": 0,
          "decode": {"free_slots": 4, "pages_free": 16, "tp": 2, "ep": 1,
-                    "mesh_shape": {"tp": 2}}},
+                    "pp": 2, "stages": 2, "mesh_shape": {"pp": 2, "tp": 2}}},
         {"status": "ok", "queue_depth": 0, "in_flight": 0,
          "decode": {"free_slots": 4, "pages_free": 32}},  # unsharded replica
     ]
@@ -349,14 +349,19 @@ def test_fleet_model_parallel_gauges_prometheus_exposition():
     mem.probe_all()  # parses the bodies and publishes the gauges
     try:
         rows = mem.snapshot()
-        assert rows[0]["tp"] == 2 and rows[0]["mesh_shape"] == {"tp": 2}
-        assert rows[1]["tp"] == 1 and rows[1]["mesh_shape"] is None
+        assert rows[0]["tp"] == 2 and rows[0]["pp"] == 2
+        assert rows[0]["mesh_shape"] == {"pp": 2, "tp": 2}
+        assert rows[1]["tp"] == 1 and rows[1]["pp"] == 1
+        assert rows[1]["mesh_shape"] is None
         text = prometheus_text(m)
         for fam in ("router_replica0_tp", "router_replica0_ep",
-                    "router_replica1_tp", "router_replica1_ep"):
+                    "router_replica0_pp", "router_replica1_tp",
+                    "router_replica1_ep", "router_replica1_pp"):
             assert f"# TYPE {fam} gauge" in text, fam
         assert "router_replica0_tp 2.0" in text
+        assert "router_replica0_pp 2.0" in text
         assert "router_replica1_tp 1.0" in text
+        assert "router_replica1_pp 1.0" in text
         assert "router_replica0_kv_pages_free 16.0" in text
     finally:
         mem.stop()
