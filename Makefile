@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -141,6 +141,17 @@ lint-graft:
 # reports required across engine/KV/metrics shared state (docs/analysis.md)
 race-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/race_smoke.py
+
+# live weight-publication smoke: the weightstore suite (crash-consistent
+# publish, hot swap, canary gate, lock/race lints), then a real server
+# subprocess hot-swapping weights mid-burst — one good publish (healthz
+# version flips exactly once) and one corrupted publish (invisible to
+# clients, last-good kept) with zero failures and a clean SIGTERM drain;
+# finishes with the hot-swap inter-token latency benchmark (docs/serving.md)
+swap-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_weightstore.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/swap_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --hot-swap
 
 # observability smoke: the spans/stepstats/prometheus/request-tracing suite,
 # then the span-overhead micro-bench (docs/observability.md)

@@ -221,7 +221,9 @@ class ElasticParamStore:
                  dampening="inverse",
                  lease_ttl_s: float = 10.0,
                  clock: Callable[[], float] = time.monotonic,
-                 metrics=None):
+                 metrics=None,
+                 publish_to=None,
+                 publish_every: int = 0):
         if max_staleness < 0:
             raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
         if metrics is None:
@@ -240,6 +242,14 @@ class ElasticParamStore:
         self._version = 0
         self._replicas: Dict[str, _Lease] = {}
         self._evictions = 0
+        # live publication (train→serve): every publish_every ACCEPTED
+        # pushes, the current weights go to a serving WeightStore — the
+        # pull side of the same versioned-weights idea this store implements
+        self.publish_every = int(publish_every)
+        if isinstance(publish_to, str):
+            from ..serving.weightstore import WeightStore
+            publish_to = WeightStore(publish_to)
+        self._publish_store = publish_to
 
         def _apply(params, opt_state, grads, scale):
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -368,8 +378,27 @@ class ElasticParamStore:
                 self._version += 1
                 lease.pushes += 1
                 self.metrics.incr("elastic/push_accepted")
-                return PushResult(True, staleness, self._version,
-                                  self._params, scale)
+                result = PushResult(True, staleness, self._version,
+                                    self._params, scale)
+                do_publish = (self._publish_store is not None
+                              and self.publish_every > 0
+                              and self._version % self.publish_every == 0)
+            # disk IO happens after the lock releases: a slow publication
+            # must never stall concurrent pulls/pushes from other replicas
+            if do_publish:
+                self._publish(result.params)
+            return result
+
+    def _publish(self, params) -> None:
+        """Best-effort live publication; a failed publish is logged and
+        counted but never fails the training push that triggered it (the
+        serving side keeps last-good weights either way)."""
+        try:
+            v = self._publish_store.publish(params)
+            self.metrics.gauge("elastic/published_version", float(v))
+        except Exception:
+            self.metrics.incr("elastic/publish_failed")
+            logger.exception("elastic: live weight publication failed")
 
     def snapshot(self):
         """``(version, params, opt_state)`` under the lock — checkpoint /
@@ -641,13 +670,15 @@ class ElasticDPEngine:
                  density_threshold: Optional[float] = 0.25,
                  lease_ttl_s: float = 10.0,
                  metrics=None, transport=None,
-                 loss_callback: Optional[Callable] = None):
+                 loss_callback: Optional[Callable] = None,
+                 publish_to=None, publish_every: int = 0):
         self.optimizer = optimizer
         self.density_threshold = density_threshold
         self.loss_callback = loss_callback
         self.store = ElasticParamStore(
             init_params, optimizer, max_staleness=max_staleness,
-            dampening=dampening, lease_ttl_s=lease_ttl_s, metrics=metrics)
+            dampening=dampening, lease_ttl_s=lease_ttl_s, metrics=metrics,
+            publish_to=publish_to, publish_every=publish_every)
         self.transport = (transport if transport is not None
                           else InProcessTransport(self.store))
 

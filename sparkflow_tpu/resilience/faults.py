@@ -8,7 +8,10 @@ Two complementary mechanisms:
    atomic rename, ``"elastic.push"`` / ``"elastic.pull"`` around the elastic
    parameter store's weight/gradient exchange, ``"router.dispatch"`` /
    ``"replica.predict"`` around the serving router's admission and its
-   per-replica forwarding attempts). The call is a no-op dict
+   per-replica forwarding attempts, ``"weights.publish_commit"`` between a
+   weight publication's manifest write and its atomic rename,
+   ``"weights.pull"`` on every ``WeightStore.load``, and ``"engine.swap"``
+   inside the engines' hot-swap paths). The call is a no-op dict
    probe unless a test has armed the
    point via the :func:`inject` context manager — which can raise a chosen
    exception on chosen call indices (or with a seeded probability) and/or
@@ -36,7 +39,8 @@ from contextlib import contextmanager
 from typing import Dict, Iterable, Optional, Tuple
 
 __all__ = ["InjectedFault", "inject", "fire", "crash_at", "sigterm_at",
-           "corrupt_file", "truncate_file", "corrupt_latest_checkpoint"]
+           "corrupt_file", "truncate_file", "corrupt_latest_checkpoint",
+           "corrupt_latest_weights"]
 
 
 class InjectedFault(Exception):
@@ -232,3 +236,38 @@ def corrupt_latest_checkpoint(directory: str, mode: str = "flip",
     _size, target = max(candidates, key=lambda t: (t[0], t[1]))
     corrupt_file(target, mode, seed=seed)
     return step, target
+
+
+def corrupt_latest_weights(directory: str, mode: str = "flip",
+                           seed: int = 0) -> Tuple[int, str]:
+    """Corrupt the newest published version under a
+    :class:`~sparkflow_tpu.serving.weightstore.WeightStore` directory the
+    way a crash or bit-rot would, returning ``(version, damaged_path)`` —
+    the weight-publication mirror of :func:`corrupt_latest_checkpoint`.
+
+    Modes: ``'flip'`` / ``'truncate'`` damage the version's weight file
+    (the manifest checksum then catches it); ``'manifest'`` garbles the
+    version's manifest.json; ``'latest_json'`` garbles the ``latest.json``
+    pointer (``latest_version`` must fall back to scanning).
+    """
+    from ..serving.weightstore import (MANIFEST_NAME, WEIGHTS_NAME,
+                                       WeightStore)
+    store = WeightStore(directory)
+    if mode == "latest_json":
+        p = os.path.join(store.directory, "latest.json")
+        with open(p, "w") as f:
+            f.write('{"latest_version": 9')  # torn mid-write
+        vs = store.all_versions()
+        return (vs[-1] if vs else -1), p
+    vs = store.all_versions()
+    if not vs:
+        raise FileNotFoundError(f"no published weights under {directory}")
+    version = vs[-1]
+    vdir = store._version_dir(version)
+    if mode == "manifest":
+        p = os.path.join(vdir, MANIFEST_NAME)
+        corrupt_file(p, "truncate", seed=seed)
+        return version, p
+    target = os.path.join(vdir, WEIGHTS_NAME)
+    corrupt_file(target, mode, seed=seed)
+    return version, target

@@ -47,6 +47,17 @@ users") requires:
   (pass the batcher to ``InferenceServer(generate_batcher=...)``) with the
   same backpressure, drain, and request-id contract as predict.
 
+- :class:`~sparkflow_tpu.serving.weightstore.WeightStore` /
+  :class:`~sparkflow_tpu.serving.weightstore.WeightWatcher` — live weight
+  publication: immutable, monotonically versioned weight sets published
+  crash-consistently (tmp dir + sha256 manifest + atomic rename), watched
+  by replicas that verify and hot-swap at a drained batch/token boundary
+  (double-buffered, zero retraces, never mixing versions in one request).
+  ``RouterServer(canary=True)`` adds version-aware canary dispatch with a
+  health gate (:class:`~sparkflow_tpu.serving.router.CanaryController`)
+  that promotes a healthy new version or instantly quarantines and rolls
+  back a bad one — a corrupt or regressing publish never takes traffic.
+
 See ``docs/serving.md``, ``docs/resilience.md``, and
 ``examples/serving_example.py``; ``make fleet-smoke`` chaos-tests the
 router + replicas end to end; ``make decode-smoke`` does the same for
@@ -59,12 +70,15 @@ from .decode import DecodeEngine
 from .engine import InferenceEngine
 from .kvcache import OutOfPages, PagedKVCache
 from .membership import BreakerState, CircuitBreaker, Membership, Replica
-from .router import ResultCache, RouterServer, TokenBucket
+from .router import (CanaryController, ResultCache, RouterServer,
+                     TokenBucket)
 from .server import InferenceServer
+from .weightstore import WeightStore, WeightStoreError, WeightWatcher
 
 __all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
            "InferenceServer", "ServingClient", "ServingError",
            "ConnectionPool", "RouterServer", "Membership", "Replica",
            "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache",
            "DecodeEngine", "ContinuousBatcher", "PagedKVCache",
-           "OutOfPages"]
+           "OutOfPages", "WeightStore", "WeightWatcher", "WeightStoreError",
+           "CanaryController"]

@@ -95,7 +95,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,6 +104,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..analysis.runtime_guards import RecompileGuard
 from ..obs.spans import span as obs_span
+from ..resilience import faults
 from ..ops import paged_attention, paged_attention_verify
 from ..utils import metrics as metrics_mod
 from ..utils.tracing import annotate
@@ -373,42 +374,17 @@ class DecodeEngine:
         if isinstance(params, (list, tuple)):
             from ..graphdef import list_to_params
             params = list_to_params(model, list(params))
+        # shape/dtype template of the ctor params in STANDARD layout
+        # (pre-pack, pre-split): every hot swap validates against it, so the
+        # compiled prefill/decode executables are reused with zero retraces
+        self._weights_template = jax.tree.map(
+            lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       if hasattr(a, "dtype")
+                       else jax.ShapeDtypeStruct(np.shape(a),
+                                                 np.asarray(a).dtype)),
+            params)
         self._param_specs = None
-        if self._sharded:
-            from ..parallel.tp import (derive_param_pspecs, filter_pspec,
-                                       shard_params, tp_pack_params)
-            if self._tp > 1:
-                # shard_map hands each rank a contiguous column block: permute
-                # qkv columns to (tp, 3, H/tp, d) order and pre-divide the
-                # row-parallel biases so the decode psums are exact
-                params = tp_pack_params(model, params, self._tp)
-            pspecs = derive_param_pspecs(model, self.mesh, self.sharding)
-            if pspecs is None:
-                # pp-only mesh: no tp/ep axis shards weight columns, every
-                # leaf starts replicated (the stage split below re-lays the
-                # block leaves out over pp_axis)
-                pspecs = jax.tree.map(lambda s: P(), model.param_pspecs(),
-                                      is_leaf=lambda x: isinstance(x, P))
-            self._param_specs = jax.tree.map(
-                lambda s: filter_pspec(s, self.mesh), pspecs,
-                is_leaf=lambda x: isinstance(x, P))
-            if self._pp > 1:
-                # depth split (parallel/pp.py layout): per-block leaves
-                # stack to [pp, layers/pp, ...] with the leading stage axis
-                # sharded over pp_axis — each stage holds only its own
-                # blocks' weights at rest. embed/final_ln replicate: every
-                # stage runs entry/exit unconditionally in the no-cond
-                # staged schedule, and the block leaves keep any megatron
-                # tp columns behind the stage axes (2D pp x tp).
-                from ..parallel.pp import (split_stage_params,
-                                           split_stage_pspecs)
-                params = split_stage_params(model, params, self._pp)
-                self._param_specs = split_stage_pspecs(
-                    self._pp_axis, self._param_specs["block_0"],
-                    {k: v for k, v in self._param_specs.items()
-                     if not k.startswith("block_")})
-            params = shard_params(params, self.mesh, self._param_specs)
-        self._params = params
+        self._params = self._prepare_params(params)
         pool_dtype = (model.compute_dtype if model.compute_dtype is not None
                       else jnp.float32)
         # GLOBAL pool shape; under tp the heads axis shards across the mesh
@@ -502,6 +478,12 @@ class DecodeEngine:
         self._spec_accepted = 0
         self._spec_draft_ms = 0.0
         self._spec_verify_ms = 0.0
+        # hot-swap state (guarded by self._lock): a prepared-but-unapplied
+        # (params, version) double buffer waiting for a drained token
+        # boundary — no active slots, no chunked prefills in flight
+        self._pending_swap: Optional[Tuple[Any, int]] = None
+        self._serving_version = 0  # 0 = ctor weights
+        self._swaps = 0
         if self._pp > 1:
             # the staged builders shadow the flat-stack methods on this
             # instance, so everything downstream — the _fused_fn
@@ -514,6 +496,51 @@ class DecodeEngine:
             self._verify_fn = self._pp_verify_fn
         if warmup:
             self.warmup()
+
+    def _prepare_params(self, params):
+        """Pack/split/shard one standard-layout tree into this engine's
+        serving placement (tp column packing, pp stage split, GSPMD
+        shardings). The ctor and every hot swap run exactly this path, so a
+        swapped tree lands bit-identical to a cold start. Must be called
+        OUTSIDE ``self._lock`` — device placement is the slow half of a swap
+        and decode keeps serving the old tree meanwhile."""
+        model = self.model
+        if not self._sharded:
+            return params
+        from ..parallel.tp import (derive_param_pspecs, filter_pspec,
+                                   shard_params, tp_pack_params)
+        if self._tp > 1:
+            # shard_map hands each rank a contiguous column block: permute
+            # qkv columns to (tp, 3, H/tp, d) order and pre-divide the
+            # row-parallel biases so the decode psums are exact
+            params = tp_pack_params(model, params, self._tp)
+        pspecs = derive_param_pspecs(model, self.mesh, self.sharding)
+        if pspecs is None:
+            # pp-only mesh: no tp/ep axis shards weight columns, every
+            # leaf starts replicated (the stage split below re-lays the
+            # block leaves out over pp_axis)
+            pspecs = jax.tree.map(lambda s: P(), model.param_pspecs(),
+                                  is_leaf=lambda x: isinstance(x, P))
+        specs = jax.tree.map(
+            lambda s: filter_pspec(s, self.mesh), pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        if self._pp > 1:
+            # depth split (parallel/pp.py layout): per-block leaves
+            # stack to [pp, layers/pp, ...] with the leading stage axis
+            # sharded over pp_axis — each stage holds only its own
+            # blocks' weights at rest. embed/final_ln replicate: every
+            # stage runs entry/exit unconditionally in the no-cond
+            # staged schedule, and the block leaves keep any megatron
+            # tp columns behind the stage axes (2D pp x tp).
+            from ..parallel.pp import (split_stage_params,
+                                       split_stage_pspecs)
+            params = split_stage_params(model, params, self._pp)
+            specs = split_stage_pspecs(
+                self._pp_axis, specs["block_0"],
+                {k: v for k, v in specs.items()
+                 if not k.startswith("block_")})
+        self._param_specs = specs
+        return shard_params(params, self.mesh, specs)
 
     # -- jitted functions ----------------------------------------------------
 
@@ -1344,6 +1371,12 @@ class DecodeEngine:
         total = prompt_len + max(1, int(max_new_tokens))
         if total > self.max_seq_len:
             return False
+        with self._lock:
+            if (self._pending_swap is not None
+                    and not self._maybe_swap_locked()):
+                # a prepared weight swap is waiting for the drained boundary;
+                # hold new admissions so it lands (callers queue, no failures)
+                return False
         return self.kv.can_admit(
             total, list(prompt) if (prompt is not None
                                     and self.prefix_cache) else None)
@@ -1373,6 +1406,11 @@ class DecodeEngine:
             raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
                              f"max_seq_len={self.max_seq_len}")
         with self._lock:
+            if (self._pending_swap is not None
+                    and not self._maybe_swap_locked()):
+                # backpressure, not failure: the batcher requeues and the
+                # swap lands once the active slots drain
+                raise OutOfPages("weight swap pending at token boundary")
             slot = self.kv.free_slot()
             if slot is None:
                 raise OutOfPages("no free decode slot")
@@ -1488,6 +1526,8 @@ class DecodeEngine:
         same steady-state tokens/sec, every stage busy. Pending chunked
         prefills drain the pipeline first, then run the flat fused call."""
         with self._lock:
+            if self._pending_swap is not None:
+                self._maybe_swap_locked()  # lands iff fully drained
             active = self.kv.active_slots()
             ready = np.asarray([int(s) for s in active
                                 if self._decode_ready[s]], np.int64)
@@ -1799,6 +1839,82 @@ class DecodeEngine:
     def active_slots(self) -> np.ndarray:
         return self.kv.active_slots()
 
+    # -- live weight hot-swap ------------------------------------------------
+
+    def weights_template(self):
+        """Shape/dtype template (``ShapeDtypeStruct`` tree, standard layout)
+        of the ctor params — what a published tree must match leaf-for-leaf
+        for :meth:`swap_params` to accept it."""
+        return self._weights_template
+
+    def swap_params(self, params, *, version: Optional[int] = None) -> bool:
+        """Stage a hot swap of the serving weights. ``params`` is a flat
+        list or a standard-layout pytree with every leaf's shape/dtype
+        identical to the ctor tree (enforced — all compiled executables are
+        reused, zero retraces). Double-buffered: the tree is packed/split/
+        sharded onto devices OUTSIDE the engine lock while the old weights
+        keep serving, then parked as ``_pending_swap`` and applied only at a
+        fully drained token boundary (no active slots, no chunked prefills)
+        so no sequence ever decodes under two versions. ``can_admit`` holds
+        new admissions while a swap is pending, which drains the engine in
+        bounded time under continuous load. Returns True if the swap applied
+        immediately (engine idle), False if parked."""
+        faults.fire("engine.swap")  # chaos hook; no-op unless armed
+        if isinstance(params, (list, tuple)):
+            from ..graphdef import list_to_params
+            params = list_to_params(self.model, list(params))
+        flat, treedef = jax.tree.flatten(params)
+        want, want_def = jax.tree.flatten(self._weights_template)
+        if treedef != want_def:
+            raise ValueError("swapped params have a different tree "
+                             "structure than the ctor params")
+        for i, (got, w) in enumerate(zip(flat, want)):
+            gshape = tuple(np.shape(got))
+            gdtype = (np.dtype(got.dtype) if hasattr(got, "dtype")
+                      else np.asarray(got).dtype)
+            if gshape != tuple(w.shape) or gdtype != np.dtype(w.dtype):
+                raise ValueError(
+                    f"swapped params leaf {i} is {gshape}/{gdtype}, "
+                    f"expected {tuple(w.shape)}/{np.dtype(w.dtype)}: hot "
+                    f"swap requires unchanged shapes")
+        prepared = self._prepare_params(params)  # old tree still serving
+        with self._lock:
+            v = (int(version) if version is not None
+                 else self._serving_version + 1)
+            self._pending_swap = (prepared, v)
+            return self._maybe_swap_locked()
+
+    def _maybe_swap_locked(self) -> bool:
+        """Apply the pending swap iff the engine is at a fully drained token
+        boundary. Caller holds ``self._lock``."""
+        if self._pending_swap is None:
+            return False
+        if self.kv.active_slots().size or self._pending:
+            return False
+        params, version = self._pending_swap
+        self._pending_swap = None
+        self._params = params  # the swap: one reference assignment
+        if self.prefix_cache:
+            # old-version K/V must not seed post-swap prompts: a prefix hit
+            # would splice stale activations under the new weights and break
+            # bitwise parity with a cold start
+            self.kv.flush_prefix_index()
+        self._serving_version = version
+        self._swaps += 1
+        self.metrics.gauge("serving/version", float(version))
+        return True
+
+    def maybe_swap(self) -> bool:
+        """Try to land a pending swap (watcher nudge for idle engines).
+        Returns True if a swap applied on this call."""
+        with self._lock:
+            return self._maybe_swap_locked()
+
+    def serving_version(self) -> int:
+        """Version of the weights currently serving (0 = ctor weights)."""
+        with self._lock:
+            return self._serving_version
+
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
@@ -1816,6 +1932,9 @@ class DecodeEngine:
                 "steps": self._steps,
                 "tokens_out": self._tokens_out,
                 "prefills": self._prefills,
+                "serving_version": self._serving_version,
+                "swaps": self._swaps,
+                "pending_swap": self._pending_swap is not None,
                 "spec": {
                     "enabled": bool(self.spec_k),
                     "k": self.spec_k,

@@ -145,12 +145,22 @@ class InferenceEngine:
         for n in names + [output_name]:
             self.model.graphdef.resolve(n)
 
-        self._params = self._load_params(weights)
+        params = self._load_params(weights)
+        # shape/dtype template of the ctor weights in STANDARD layout,
+        # captured before quantize/shard: every hot swap validates against
+        # it (shapes pinned unchanged so the AOT ladder is reused as-is)
+        self._weights_template = jax.tree.map(
+            lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       if hasattr(a, "dtype")
+                       else jax.ShapeDtypeStruct(np.shape(a),
+                                                 np.asarray(a).dtype)),
+            params)
         # model-parallel predict: a config naming tp_axis/ep_axis present on
         # the mesh shards attention/MLP weights (megatron rules) and expert
         # banks instead of replicating — GSPMD partitions the matmuls and
         # inserts the all-reduces from the param shardings alone
         self._tp_specs = None
+        self._quant_min_size = int(quant_min_size)
         mp = (self.mesh is not None
               and self.sharding.tp_size(self.mesh)
               * self.sharding.ep_size(self.mesh) > 1)
@@ -159,28 +169,21 @@ class InferenceEngine:
                              "parallel serving (int8 packing breaks the "
                              "megatron layout); pick one")
         if quantize:
-            from ..utils.quant import MODES, quantize_params
+            from ..utils.quant import MODES
             if quantize not in MODES:
                 raise ValueError(f"quantize must be one of {MODES} (or None), "
                                  f"got {quantize!r}")
             self.model.quant_mode = quantize
-            self._params = quantize_params(self._params,
-                                           min_size=quant_min_size)
         if mp:
             if not hasattr(self.model, "param_pspecs"):
                 raise TypeError("model-parallel serving needs the model to "
                                 "publish param_pspecs() (megatron rules)")
-            from ..parallel.tp import (derive_param_pspecs, filter_pspec,
-                                       shard_params)
+            from ..parallel.tp import derive_param_pspecs, filter_pspec
             pspecs = derive_param_pspecs(self.model, self.mesh, self.sharding)
             self._tp_specs = jax.tree.map(
                 lambda s: filter_pspec(s, self.mesh), pspecs,
                 is_leaf=lambda x: isinstance(x, P))
-            self._params = shard_params(self._params, self.mesh,
-                                        self._tp_specs)
-        elif self.mesh is not None and self.mesh.size > 1:
-            self._params = jax.device_put(
-                self._params, NamedSharding(self.mesh, P()))
+        self._params = self._place_params(params)
 
         self._in_shapes, self._in_dtypes = self._input_layouts()
         self.buckets = _bucket_ladder(self.max_batch)
@@ -194,6 +197,8 @@ class InferenceEngine:
         self.fallback_compiles = 0
         self._requests = 0
         self._rows = 0
+        self._serving_version = 0  # bumped by swap_params; 0 = ctor weights
+        self._swaps = 0
         # persistent XLA compilation cache: with a directory set, warmup's
         # bucket compiles hit cached executables from earlier processes
         # instead of re-running XLA — the restart-latency knob. hits/misses
@@ -239,6 +244,24 @@ class InferenceEngine:
         if isinstance(weights, (list, tuple)):
             return list_to_params(self.model, list(weights))
         return weights  # already a params pytree
+
+    def _place_params(self, params):
+        """Quantize/shard/replicate one standard-layout tree into this
+        engine's serving placement. The ctor and every hot swap run exactly
+        this path, so a swapped tree lands bit-identical to a cold start."""
+        if self.quantize:
+            from ..utils.quant import quantize_params
+            params = quantize_params(params, min_size=self._quant_min_size)
+        if self._tp_specs is not None:
+            from ..parallel.tp import shard_params
+            params = shard_params(params, self.mesh, self._tp_specs)
+        elif self.mesh is not None and self.mesh.size > 1:
+            params = jax.device_put(params, NamedSharding(self.mesh, P()))
+        return params
+
+    def _snapshot_params(self):
+        with self._stats_lock:
+            return self._params
 
     def _input_layouts(self) -> Tuple[List[Tuple[int, ...]], List[Any]]:
         specs = self.model.input_specs()
@@ -287,10 +310,11 @@ class InferenceEngine:
         # after warmup() marks steady state, any further trace is a
         # regression the ladder was supposed to prevent (GC-R401)
         predict = self.recompile_guard.wrap(self._apply_fn())
+        params = self._snapshot_params()
         params_struct = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
             if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape, a.dtype),
-            self._params)
+            params)
         mesh = self.mesh
         if mesh is None or mesh.size <= 1:
             jitted = jax.jit(predict)
@@ -394,20 +418,24 @@ class InferenceEngine:
         n = xs[0].shape[0]
         if any(a.shape[0] != n for a in xs):
             raise ValueError("multi-input arrays must share the batch dim")
+        # one params snapshot per request: a concurrent hot swap never gives
+        # a chunked request mixed versions — every chunk runs the same tree
+        params = self._snapshot_params()
         if n == 0:
-            probe = self._run(tuple(a[:0] for a in xs), 0, probe_rows=1)
+            probe = self._run(tuple(a[:0] for a in xs), 0, params,
+                              probe_rows=1)
             return probe[:0]
         with self._stats_lock:
             self._requests += 1
             self._rows += n
         if n > self.max_batch:
             outs = [self._run(tuple(a[i:i + self.max_batch] for a in xs),
-                              min(self.max_batch, n - i))
+                              min(self.max_batch, n - i), params)
                     for i in range(0, n, self.max_batch)]
             return np.concatenate(outs, axis=0)
-        return self._run(xs, n)
+        return self._run(xs, n, params)
 
-    def _run(self, xs, n: int, probe_rows: int = 0) -> np.ndarray:
+    def _run(self, xs, n: int, params, probe_rows: int = 0) -> np.ndarray:
         have = max(n, probe_rows)
         bucket = self._bucket_for(have)
         if have < bucket:
@@ -425,15 +453,72 @@ class InferenceEngine:
         # same named range still shows in JAX profiler captures
         with obs_span("serving/engine_apply", args={"bucket": bucket},
                       jax_annotation=True):
-            out = exe(self._params, xs if self._multi else xs[0])
+            out = exe(params, xs if self._multi else xs[0])
         return np.asarray(out)[:n]
+
+    # -- live weight hot-swap ------------------------------------------------
+
+    def weights_template(self):
+        """Shape/dtype template (``ShapeDtypeStruct`` tree, standard layout)
+        of the ctor weights — what a published tree must match leaf-for-leaf
+        for :meth:`swap_params` to accept it."""
+        return self._weights_template
+
+    def swap_params(self, weights, *, version: Optional[int] = None) -> bool:
+        """Hot-swap the serving weights without a restart. ``weights`` is
+        anything the ctor accepts, in the model's STANDARD layout, with every
+        leaf's shape/dtype identical to the ctor tree (enforced — the AOT
+        bucket executables are reused as-is, so the swap causes zero
+        retraces). Double-buffered: the new tree is quantized/sharded/placed
+        on device while the old one keeps serving, then swapped in a single
+        reference assignment; in-flight predicts hold their snapshot, so no
+        request ever observes mixed versions. Returns True (swaps apply
+        immediately on this engine)."""
+        faults.fire("engine.swap")  # chaos hook; no-op unless armed
+        params = self._load_params(weights)
+        flat, treedef = jax.tree.flatten(params)
+        want, want_def = jax.tree.flatten(self._weights_template)
+        if treedef != want_def:
+            raise ValueError("swapped weights have a different tree "
+                             "structure than the ctor weights")
+        for i, (got, w) in enumerate(zip(flat, want)):
+            gshape = tuple(np.shape(got))
+            gdtype = (np.dtype(got.dtype) if hasattr(got, "dtype")
+                      else np.asarray(got).dtype)
+            if gshape != tuple(w.shape) or gdtype != np.dtype(w.dtype):
+                raise ValueError(
+                    f"swapped weights leaf {i} is {gshape}/{gdtype}, "
+                    f"expected {tuple(w.shape)}/{np.dtype(w.dtype)}: hot "
+                    f"swap requires unchanged shapes")
+        placed = self._place_params(params)  # old tree still serving
+        with self._stats_lock:
+            self._params = placed  # the swap: one reference assignment
+            v = (int(version) if version is not None
+                 else self._serving_version + 1)
+            self._serving_version = v
+            self._swaps += 1
+        self.metrics.gauge("serving/version", float(v))
+        return True
+
+    def serving_version(self) -> int:
+        """Version of the weights currently serving (0 = ctor weights)."""
+        with self._stats_lock:
+            return self._serving_version
+
+    def maybe_swap(self) -> bool:
+        """Swaps apply immediately on this engine; nothing is deferred."""
+        return True
 
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
         with self._stats_lock:
             requests, rows = self._requests, self._rows
+            serving_version, swaps = self._serving_version, self._swaps
+            params = self._params
         return {"buckets": list(self.buckets),
+                "serving_version": serving_version,
+                "swaps": swaps,
                 "sharding": self.sharding.describe(),
                 "aot_compiles": self.aot_compiles,
                 "fallback_compiles": self.fallback_compiles,
@@ -455,4 +540,4 @@ class InferenceEngine:
                        if self.mesh is not None else 1),
                 "param_bytes_per_device": sum(
                     per_device_bytes(leaf)
-                    for leaf in jax.tree.leaves(self._params))}
+                    for leaf in jax.tree.leaves(params))}

@@ -233,6 +233,24 @@ class PagedKVCache:
                 added += 1
             return added
 
+    def flush_prefix_index(self) -> int:
+        """Forget every indexed shared prefix: cached-tier pages (refcount
+        0) return to the plain free list; pages still referenced by active
+        slots merely lose their index entry, so their holders keep decoding
+        but no new request can map them. The weight hot-swap calls this —
+        indexed K/V was computed under the OLD weights, and serving it to a
+        post-swap prompt would silently mix versions. Returns the number of
+        dropped index entries."""
+        with self._lock:
+            dropped = len(self._prefix_index)
+            self._prefix_index.clear()
+            self._page_key.clear()
+            while self._cached:
+                pid, _ = self._cached.popitem(last=False)
+                self._free.append(pid)
+            self._export_gauges_locked()
+            return dropped
+
     def _avail_locked(self) -> int:
         """Pages available to new demand: the free list plus the evictable
         cached tier, minus outstanding reservations."""

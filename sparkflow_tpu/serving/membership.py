@@ -189,6 +189,9 @@ class Replica:
         self.tp = 1
         self.ep = 1
         self.pp = 1
+        # live-weight version from /healthz ("serving_version"); -1 = not
+        # yet probed. Canary dispatch keys on this.
+        self.version = -1
         self.successes = 0
         self.failures = 0
         self.hedges = 0              # hedge requests sent to this replica
@@ -208,11 +211,16 @@ class Membership:
                  failure_threshold: int = 3,
                  recovery_s: float = 2.0,
                  metrics: Optional[metrics_mod.Metrics] = None,
+                 version_policy=None,
                  clock: Callable[[], float] = time.monotonic):
         if not urls:
             raise ValueError("at least one replica url is required")
         self.probe_interval_s = float(probe_interval_s)
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        # version_policy: an object with filter_replicas(ordered, version_of)
+        # — the router's CanaryController plugs in here to do version-aware
+        # (canary-weighted, quarantine-excluding) dispatch
+        self.version_policy = version_policy
         self._clock = clock
         self._lock = threading.Lock()
         self._replicas: List[Replica] = [
@@ -274,6 +282,10 @@ class Membership:
             if ok:
                 replica.queue_depth = int(body.get("queue_depth", 0))
                 replica.reported_in_flight = int(body.get("in_flight", 0))
+                try:
+                    replica.version = int(body.get("serving_version", -1))
+                except (TypeError, ValueError):
+                    replica.version = -1
                 dec = body.get("decode")
                 if isinstance(dec, dict):
                     replica.decode_free_slots = int(dec.get("free_slots", -1))
@@ -342,6 +354,12 @@ class Membership:
                 (r for r in self._replicas
                  if id(r) not in skip and r.healthy),
                 key=key)
+            versions = {id(r): r.version for r in ordered}
+        if self.version_policy is not None and ordered:
+            # canary weighting + quarantine exclusion, applied to the
+            # load-sorted list OUTSIDE the lock (the policy has its own)
+            ordered = self.version_policy.filter_replicas(
+                ordered, lambda r: versions.get(id(r), -1))
         # breaker.allow() outside the membership lock, in load order, and
         # ONLY until the first taker: allow() on a HALF_OPEN breaker claims
         # its single trial slot, so probing replicas we then don't dispatch
@@ -373,6 +391,11 @@ class Membership:
         if replica.breaker.state is BreakerState.OPEN:
             logger.warning("router: circuit opened for replica %s%s",
                            replica.url, f" ({reason})" if reason else "")
+
+    def version_of(self, replica: Replica) -> int:
+        """Last probed serving_version of ``replica`` (-1 = unknown)."""
+        with self._lock:
+            return replica.version
 
     def eject(self, replica: Replica, reason: str = "") -> None:
         """Immediate removal from rotation (draining replica): trip the
@@ -406,6 +429,7 @@ class Membership:
                          decode_pages_free=r.decode_pages_free,
                          decode_spec_accept_rate=r.decode_spec_accept_rate,
                          mesh_shape=r.mesh_shape, tp=r.tp, ep=r.ep, pp=r.pp,
+                         version=r.version,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
                     for r in self._replicas]
@@ -417,7 +441,7 @@ class Membership:
     def publish_gauges(self) -> None:
         """Export the fleet table as Prometheus gauges:
         ``router/replica<i>/{healthy,ejected,inflight,error_rate,hedges,
-        kv_pages_free,spec_accept_rate,tp,ep,pp}``."""
+        kv_pages_free,spec_accept_rate,tp,ep,pp,version}``."""
         for row in self.snapshot():
             prefix = f"router/replica{row['index']}"
             total = row["successes"] + row["failures"]
@@ -440,3 +464,6 @@ class Membership:
             self.metrics.gauge(f"{prefix}/tp", float(row["tp"]))
             self.metrics.gauge(f"{prefix}/ep", float(row["ep"]))
             self.metrics.gauge(f"{prefix}/pp", float(row["pp"]))
+            # live-weight version per replica: a rollout (or a rollback)
+            # is visible as this gauge stepping across the fleet
+            self.metrics.gauge(f"{prefix}/version", float(row["version"]))

@@ -42,6 +42,9 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
+import math
+import random
 import threading
 import time
 import uuid
@@ -59,7 +62,9 @@ from ..utils import metrics as metrics_mod
 from .client import _STALE_CONN_ERRORS
 from .membership import Membership, Replica
 
-__all__ = ["RouterServer", "TokenBucket", "ResultCache"]
+__all__ = ["RouterServer", "TokenBucket", "ResultCache", "CanaryController"]
+
+logger = logging.getLogger("sparkflow_tpu")
 
 
 class TokenBucket:
@@ -139,6 +144,245 @@ class ResultCache:
                     "misses": self.misses}
 
 
+def _response_has_nan(obj: Dict[str, Any], cap: int = 4096) -> bool:
+    """Scan a predict response's ``predictions`` for NaN/Inf (the canary
+    gate's numerical-health signal), visiting at most ``cap`` scalars."""
+    seen = 0
+    stack = [obj.get("predictions")]
+    while stack and seen < cap:
+        v = stack.pop()
+        if isinstance(v, list):
+            stack.extend(v)
+        elif isinstance(v, float):
+            seen += 1
+            if math.isnan(v) or math.isinf(v):
+                return True
+    return False
+
+
+class CanaryController:
+    """Health-gated canary rollout over live-weight versions.
+
+    Plugs into :class:`~sparkflow_tpu.serving.membership.Membership` as its
+    ``version_policy`` and is fed every dispatch outcome by the router. The
+    fleet's versions split into three roles: the **incumbent** (first
+    version seen), a **canary** (any strictly newer version that appears as
+    replicas hot-swap), and **quarantined** versions (failed canaries).
+    While a canary is under trial, roughly ``canary_fraction`` of picks
+    prefer canary replicas — weighted version-aware dispatch — and its
+    outcomes accumulate per-version. The gate then decides:
+
+    - any NaN/Inf in a canary response → **instant rollback**;
+    - after ``min_requests``: error rate above the incumbent's by more than
+      ``error_rate_margin``, or latency p95 above
+      ``max(latency_floor_ms, latency_factor x incumbent p95)`` →
+      **rollback**; otherwise → **promote** (the canary becomes incumbent).
+
+    Rollback quarantines the version — the picker excludes its replicas, so
+    a bad publish takes ZERO post-gate traffic — and, when a ``store``
+    (:class:`~sparkflow_tpu.serving.weightstore.WeightStore`) is wired,
+    repoints it at the last good version so every watcher reverts too.
+
+    Lock order: ``CanaryController._lock`` is a leaf — taken after
+    ``Membership._lock`` releases (the picker calls :meth:`filter_replicas`
+    outside it) and never held across store or network calls.
+    """
+
+    MAX_LAT_SAMPLES = 512  # per-version latency ring for the p95 gate
+
+    def __init__(self, *, min_requests: int = 20,
+                 canary_fraction: float = 0.25,
+                 error_rate_margin: float = 0.05,
+                 latency_factor: float = 2.0,
+                 latency_floor_ms: float = 5.0,
+                 store=None,
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 seed: int = 0):
+        if not 0.0 < canary_fraction < 1.0:
+            raise ValueError(f"canary_fraction must be in (0, 1), got "
+                             f"{canary_fraction}")
+        if min_requests < 1:
+            raise ValueError(f"min_requests must be >= 1, got {min_requests}")
+        self.min_requests = int(min_requests)
+        self.canary_fraction = float(canary_fraction)
+        self.error_rate_margin = float(error_rate_margin)
+        self.latency_factor = float(latency_factor)
+        self.latency_floor_ms = float(latency_floor_ms)
+        self.store = store
+        self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stats: Dict[int, Dict[str, Any]] = {}
+        self.incumbent: Optional[int] = None
+        self.canary: Optional[int] = None
+        self.quarantined: set = set()
+        self.promotions = 0
+        self.rollbacks = 0
+
+    # -- roles ---------------------------------------------------------------
+
+    def _note_version_locked(self, version: int) -> None:
+        if version < 0 or version in self.quarantined:
+            return
+        if self.incumbent is None:
+            self.incumbent = version
+            return
+        base = self.incumbent if self.canary is None else self.canary
+        if version > base:
+            # the newest version in the fleet is the canary under trial
+            self.canary = version
+
+    def _stats_for_locked(self, version: int) -> Dict[str, Any]:
+        st = self._stats.get(version)
+        if st is None:
+            st = self._stats[version] = {"requests": 0, "errors": 0,
+                                         "nans": 0, "lat": []}
+        return st
+
+    @staticmethod
+    def _p95(lat: List[float]) -> float:
+        if not lat:
+            return 0.0
+        s = sorted(lat)
+        return s[min(len(s) - 1, int(round(0.95 * (len(s) - 1))))]
+
+    # -- the gate ------------------------------------------------------------
+
+    def observe(self, version: Optional[int], ok: bool,
+                latency_ms: Optional[float] = None,
+                nan: bool = False) -> None:
+        """Record one dispatch outcome against the replica's version; when
+        the version is the canary, run the health gate. Callers skip
+        overload 503s and 4xx — those say nothing about the weights."""
+        if version is None or version < 0:
+            return
+        bad = None
+        with self._lock:
+            if version in self.quarantined:
+                return
+            self._note_version_locked(version)
+            st = self._stats_for_locked(version)
+            st["requests"] += 1
+            if not ok:
+                st["errors"] += 1
+            if nan:
+                st["nans"] += 1
+            if ok and latency_ms is not None:
+                lat = st["lat"]
+                lat.append(float(latency_ms))
+                if len(lat) > self.MAX_LAT_SAMPLES:
+                    del lat[:len(lat) - self.MAX_LAT_SAMPLES]
+            if version == self.canary:
+                bad = self._gate_locked(st)
+        if bad is not None and self.store is not None:
+            # outside our lock: the store takes its own, and a slow disk
+            # must not stall the dispatch path
+            try:
+                self.store.rollback(bad_version=bad)
+            except Exception:  # noqa: BLE001 - quarantine already protects
+                logger.exception("canary: weight-store rollback for "
+                                 "version %d failed", bad)
+
+    def _gate_locked(self, st: Dict[str, Any]) -> Optional[int]:
+        """Judge the canary; returns the version to roll back, or None
+        (still trialling, or promoted). Caller holds ``self._lock``."""
+        v = self.canary
+        if st["nans"]:
+            return self._rollback_locked(v, "NaN/Inf outputs")
+        if st["requests"] < self.min_requests:
+            return None
+        inc = self._stats.get(self.incumbent)
+        inc_req = inc["requests"] if inc else 0
+        inc_err = (inc["errors"] / inc_req) if inc_req else 0.0
+        err = st["errors"] / st["requests"]
+        if err > inc_err + self.error_rate_margin:
+            return self._rollback_locked(
+                v, f"error rate {err:.3f} vs incumbent {inc_err:.3f}")
+        inc_p95 = self._p95(inc["lat"]) if inc else 0.0
+        if inc_p95 > 0.0:
+            p95 = self._p95(st["lat"])
+            bar = max(self.latency_floor_ms, self.latency_factor * inc_p95)
+            if p95 > bar:
+                return self._rollback_locked(
+                    v, f"latency p95 {p95:.1f}ms > {bar:.1f}ms")
+        logger.info("canary: promoting version %d to incumbent "
+                    "(was %s)", v, self.incumbent)
+        self.incumbent, self.canary = v, None
+        self.promotions += 1
+        return None
+
+    def _rollback_locked(self, v: int, reason: str) -> int:
+        logger.warning("canary: rolling back version %d (%s)", v, reason)
+        self.quarantined.add(v)
+        self.canary = None
+        self.rollbacks += 1
+        self.metrics.incr("serving/canary_rollbacks")
+        return v
+
+    # -- membership version_policy hook --------------------------------------
+
+    def filter_replicas(self, replicas: List[Replica],
+                        version_of) -> List[Replica]:
+        """Version-aware reorder of the load-sorted candidate list.
+        Quarantined versions are dropped outright (zero post-gate traffic —
+        an all-quarantined fleet yields [] and the router 503s rather than
+        serve bad weights); with a canary under trial, ~``canary_fraction``
+        of picks put the canary group first, the rest put it last."""
+        with self._lock:
+            for v in sorted({version_of(r) for r in replicas}):
+                self._note_version_locked(v)
+            q = set(self.quarantined)
+            canary = self.canary
+            prefer_canary = self._rng.random() < self.canary_fraction
+        live = [r for r in replicas if version_of(r) not in q]
+        if canary is None:
+            return live
+        cgroup = [r for r in live if version_of(r) == canary]
+        rest = [r for r in live if version_of(r) != canary]
+        if not cgroup or not rest:
+            return live
+        return cgroup + rest if prefer_canary else rest + cgroup
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"incumbent": self.incumbent,
+                    "canary": self.canary,
+                    "quarantined": sorted(self.quarantined),
+                    "promotions": self.promotions,
+                    "rollbacks": self.rollbacks,
+                    "versions": {
+                        v: {"requests": st["requests"],
+                            "errors": st["errors"],
+                            "nans": st["nans"],
+                            "latency_p95": self._p95(st["lat"])}
+                        for v, st in self._stats.items()}}
+
+    def publish_gauges(self) -> None:
+        """Per-version health as Prometheus gauges:
+        ``serving/version<v>/{requests,errors,latency_p95}`` plus the
+        rollout state under ``serving/canary/*``."""
+        with self._lock:
+            snap = {v: (st["requests"], st["errors"], self._p95(st["lat"]))
+                    for v, st in self._stats.items()}
+            inc, can = self.incumbent, self.canary
+            nq, promos, rbs = (len(self.quarantined), self.promotions,
+                               self.rollbacks)
+        for v, (req, errs, p95) in snap.items():
+            prefix = f"serving/version{v}"
+            self.metrics.gauge(f"{prefix}/requests", float(req))
+            self.metrics.gauge(f"{prefix}/errors", float(errs))
+            self.metrics.gauge(f"{prefix}/latency_p95", float(p95))
+        self.metrics.gauge("serving/canary/incumbent",
+                           float(-1 if inc is None else inc))
+        self.metrics.gauge("serving/canary/version",
+                           float(-1 if can is None else can))
+        self.metrics.gauge("serving/canary/quarantined", float(nq))
+        self.metrics.gauge("serving/canary/promotions", float(promos))
+        self.metrics.gauge("serving/canary/rollbacks", float(rbs))
+
+
 class _CallSlot:
     """Abortable handle on one in-flight replica call — hedging's loser
     cancellation. ``abort()`` closes the checked-out connection, which
@@ -201,6 +445,11 @@ class RouterServer:
       ``router/request_ms`` (never below ``hedge_floor_ms``).
     - ``cache_size`` — entries in the content-addressed result cache;
       0 disables it.
+    - ``canary`` (+ ``canary_fraction`` / ``canary_min_requests`` /
+      ``canary_error_margin`` / ``canary_latency_factor`` /
+      ``weight_store``) — live-weight canary rollout: version-aware
+      dispatch with a health gate that promotes or instantly rolls back a
+      new weight version (see :class:`CanaryController`).
     """
 
     def __init__(self, replica_urls: Sequence[str], *,
@@ -220,16 +469,32 @@ class RouterServer:
                  cache_size: int = 0,
                  request_timeout_s: float = 30.0,
                  retry_after_s: float = 1.0,
+                 canary: bool = False,
+                 canary_fraction: float = 0.25,
+                 canary_min_requests: int = 20,
+                 canary_error_margin: float = 0.05,
+                 canary_latency_factor: float = 2.0,
+                 weight_store=None,
                  metrics: Optional[metrics_mod.Metrics] = None,
                  tracer: Optional[spans_mod.Tracer] = None):
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
         self.tracer = (tracer if tracer is not None
                        else spans_mod.default_tracer)
+        # canary=True arms version-aware dispatch + the health gate; a
+        # weight_store additionally lets a rollback repoint latest.json so
+        # every replica's watcher reverts to the last good version
+        self.canary_ctl = (CanaryController(
+            min_requests=canary_min_requests,
+            canary_fraction=canary_fraction,
+            error_rate_margin=canary_error_margin,
+            latency_factor=canary_latency_factor,
+            store=weight_store, metrics=self.metrics)
+            if canary else None)
         self.membership = Membership(
             replica_urls, probe_interval_s=probe_interval_s,
             probe_timeout_s=probe_timeout_s,
             failure_threshold=failure_threshold, recovery_s=recovery_s,
-            metrics=self.metrics)
+            metrics=self.metrics, version_policy=self.canary_ctl)
         self.dispatch_retries = int(dispatch_retries)
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=self.dispatch_retries + 1, base_s=0.05,
@@ -494,7 +759,11 @@ class RouterServer:
             if replica is None:
                 self.metrics.incr("router/no_healthy_replica")
             else:
+                t0 = time.perf_counter()
                 out = self._attempt(replica, body, headers, path)
+                if self.canary_ctl is not None:
+                    self._observe_canary(out, replica,
+                                         (time.perf_counter() - t0) * 1000.0)
                 if out["ok"]:
                     obj = out["obj"]
                     if key is not None and "predictions" in obj:
@@ -527,6 +796,24 @@ class RouterServer:
             "message": f"no replica served the request after "
                        f"{budget} attempt(s){detail}"}}, \
             {**self._retry_after(), **rid}
+
+    def _observe_canary(self, out: Dict[str, Any], picked: Replica,
+                        latency_ms: float) -> None:
+        """Feed one dispatch outcome to the canary gate, keyed by the
+        serving version of the replica that actually answered (the hedge
+        winner may differ from the pick). Overload 503s and 4xx are skipped
+        — they say nothing about the weights being trialled."""
+        replica = out.get("replica") or picked
+        ver = self.membership.version_of(replica)
+        if out["ok"]:
+            nan = _response_has_nan(out.get("obj") or {})
+            self.canary_ctl.observe(ver, ok=not nan, latency_ms=latency_ms,
+                                    nan=nan)
+            return
+        if out.get("status") == 503 or out.get("aborted"):
+            return
+        if out.get("exc") is not None or out.get("status", 0) >= 500:
+            self.canary_ctl.observe(ver, ok=False)
 
     # -- http front ----------------------------------------------------------
 
@@ -585,12 +872,16 @@ class RouterServer:
                 "replicas": replicas}
         if self.cache is not None:
             body["cache"] = self.cache.stats()
+        if self.canary_ctl is not None:
+            body["canary"] = self.canary_ctl.stats()
         if serving and healthy:
             return 200, body, None
         return 503, body, self._retry_after()
 
     def _metrics_json(self) -> Tuple[int, Dict[str, Any]]:
         self.membership.publish_gauges()
+        if self.canary_ctl is not None:
+            self.canary_ctl.publish_gauges()
         summary = self.metrics.summary()
         if self.cache is not None:
             summary["cache"] = self.cache.stats()
@@ -598,6 +889,8 @@ class RouterServer:
 
     def _metrics_prometheus(self) -> Tuple[int, str]:
         self.membership.publish_gauges()
+        if self.canary_ctl is not None:
+            self.canary_ctl.publish_gauges()
         if self.cache is not None:
             stats = self.cache.stats()
             self.metrics.gauge("router/cache_entries", stats["entries"])

@@ -89,8 +89,13 @@ class InferenceServer:
                  retry_after_s: float = 1.0,
                  tracer: Optional[spans_mod.Tracer] = None,
                  memory_watch: bool = True,
-                 memory_interval_s: float = 5.0):
+                 memory_interval_s: float = 5.0,
+                 weight_watcher=None):
         self.engine = engine
+        # optional live-weight subscription (serving.weightstore): started/
+        # stopped with the server; /healthz carries its serving_version so
+        # routers can canary by version
+        self.weight_watcher = weight_watcher
         self.tracer = (tracer if tracer is not None
                        else spans_mod.default_tracer)
         self.batcher = batcher if batcher is not None else MicroBatcher(
@@ -130,6 +135,8 @@ class InferenceServer:
         self._thread.start()
         if self.memory_watcher is not None:
             self.memory_watcher.start()
+        if self.weight_watcher is not None:
+            self.weight_watcher.start()
         self.lifecycle.transition(ServerState.SERVING)
         return self
 
@@ -180,6 +187,8 @@ class InferenceServer:
     def stop(self) -> None:
         if self._thread is None:
             return
+        if self.weight_watcher is not None:
+            self.weight_watcher.stop()  # no swaps mid-teardown
         self.drain()
         if self.memory_watcher is not None:
             self.memory_watcher.stop()
@@ -205,6 +214,8 @@ class InferenceServer:
         around a corpse (for the graceful path, use :meth:`drain`/:meth:`stop`)."""
         if self._thread is None:
             return
+        if self.weight_watcher is not None:
+            self.weight_watcher.stop()
         if self.memory_watcher is not None:
             self.memory_watcher.stop()
         self._httpd.shutdown()
@@ -361,6 +372,14 @@ class InferenceServer:
     def _retry_after(self) -> Dict[str, str]:
         return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
 
+    def _serving_version(self) -> int:
+        """Version of the weights this replica serves (0 = ctor weights, or
+        an engine without the hot-swap surface)."""
+        eng = (self.generate_batcher.engine
+               if self.generate_batcher is not None else self.engine)
+        sv = getattr(eng, "serving_version", None)
+        return int(sv()) if callable(sv) else 0
+
     def _healthz(self) -> Tuple[int, Dict[str, Any], Optional[Dict[str, str]]]:
         stats = (self.engine.stats()
                  if hasattr(self.engine, "stats") else {})
@@ -381,7 +400,12 @@ class InferenceServer:
                 "queued_rows": queue_depth,
                 "queue_depth": queue_depth,
                 "in_flight": in_flight,
+                # serving_version: harvested by Membership probes so the
+                # router can do version-aware (canary) dispatch
+                "serving_version": self._serving_version(),
                 "engine": stats}
+        if self.weight_watcher is not None:
+            body["weights"] = self.weight_watcher.stats()
         if self.generate_batcher is not None:
             gb = self.generate_batcher
             gstats = (gb.engine.stats()
@@ -423,9 +447,11 @@ class InferenceServer:
         return 503, body, self._retry_after()
 
     def _metrics(self) -> Tuple[int, Dict[str, Any]]:
+        self.metrics.gauge("serving/version", float(self._serving_version()))
         return 200, self.metrics.summary()
 
     def _metrics_prometheus(self) -> Tuple[int, str]:
+        self.metrics.gauge("serving/version", float(self._serving_version()))
         return 200, prometheus_text(self.metrics)
 
     def _make_handler(self):

@@ -127,6 +127,8 @@ class Trainer:
                  compute_dtype=None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0,
+                 publish_to=None,
+                 publish_every: int = 0,
                  resume_retries: int = 2,
                  straggler_factor: Optional[float] = None,
                  straggler_callback: Optional[Callable] = None,
@@ -256,6 +258,16 @@ class Trainer:
         # reference's save-at-end-only persistence (SURVEY.md §5)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        # live weight publication (train→serve): unlike checkpoints, which
+        # exist to restore *this* trainer, a publish hands the current
+        # weights to serving replicas (WeightWatcher hot-swap) — so it is
+        # independent of checkpoint_dir. publish_every == 0 means
+        # publish-once-at-fit-end when a store is configured.
+        self.publish_every = int(publish_every)
+        if isinstance(publish_to, str):
+            from .serving.weightstore import WeightStore
+            publish_to = WeightStore(publish_to)
+        self._publish_store = publish_to
         # pod-scale failure handling (SURVEY.md §5: the reference's
         # drop-the-update-and-print "is not acceptable at pod scale"):
         # with a checkpoint_dir configured, a failing epoch auto-restores the
@@ -1088,7 +1100,9 @@ class Trainer:
         if (k > 1 and not self.verbose and self.loss_callback is None
                 and ckpt_mgr is None and not self.straggler_factor
                 and not self.halt_on_nan and stats is None
-                and not self._offload_active):
+                and not self._offload_active
+                and not (self._publish_store is not None
+                         and self.publish_every > 0)):
             fkey = ("fused", batch, num_batches, mode, self.shuffle_per_iter,
                     n if mode == "stochastic" else None, k,
                     pspecs is not None, strategy,
@@ -1121,6 +1135,8 @@ class Trainer:
             self._last_opt_state = opt_state
             epoch_losses = [float(l) for l in jnp.mean(losses, axis=1)]
             self._warn_non_finite(epoch_losses)
+            if self._publish_store is not None:
+                self._publish_weights(params)
             return TrainResult(params, epoch_losses,
                                per_epoch * k / max(wall, 1e-9), wall)
 
@@ -1266,6 +1282,11 @@ class Trainer:
                                         std_p,
                                         self._opt_to_ckpt(std_p, opt_state),
                                         it, rng, rng_impl=self.rng_impl))
+                        if (self._publish_store is not None
+                                and self.publish_every > 0
+                                and (it % self.publish_every == 0
+                                     or it == total_epochs)):
+                            self._publish_weights(self._params_to_ckpt(params))
                         if stats is not None:
                             stats.end_step(compiled=step_compiled)
                     if preempted:
@@ -1333,8 +1354,24 @@ class Trainer:
             self._warn_non_finite(epoch_losses, epoch_keys)
         stop = ("nan" if nan_halted
                 else "preempted" if preempted else "completed")
+        # publish-at-end mode (publish_every == 0): the fit's final weights
+        # become the next served version — but never NaN-halted ones
+        if (self._publish_store is not None and self.publish_every <= 0
+                and not nan_halted):
+            self._publish_weights(params)
         return TrainResult(params, epoch_losses, seen / max(wall, 1e-9), wall,
                            stop_reason=stop)
+
+    def _publish_weights(self, std_params) -> None:
+        """Best-effort push of standard-layout weights to the configured
+        :class:`~sparkflow_tpu.serving.weightstore.WeightStore`. A failed
+        publication logs and moves on — it must never fail training, and
+        serving replicas keep last-good weights regardless."""
+        try:
+            v = self._publish_store.publish(std_params)
+            logger.info("trainer: published weights as version %d", v)
+        except Exception:
+            logger.exception("trainer: live weight publication failed")
 
     def _fit_elastic(self, features, labels, init_params,
                      multi: bool) -> TrainResult:
@@ -1381,7 +1418,8 @@ class Trainer:
             dampening=self.elastic.get("dampening", "inverse"),
             density_threshold=self.elastic.get("density_threshold", 0.25),
             lease_ttl_s=float(self.elastic.get("lease_ttl_s", 10.0)),
-            metrics=self.metrics, loss_callback=self.loss_callback)
+            metrics=self.metrics, loss_callback=self.loss_callback,
+            publish_to=self._publish_store, publish_every=self.publish_every)
 
         shards = [(features[i::replicas],
                    labels[i::replicas] if labels is not None else None)
@@ -1406,6 +1444,8 @@ class Trainer:
                 result.stats["rejected_stale"],
                 result.stats["dropped_stale"] + result.stats["dropped_fault"],
                 result.version)
+        if self._publish_store is not None and self.publish_every <= 0:
+            self._publish_weights(result.params)
         return TrainResult(result.params, result.losses,
                            result.examples_per_sec, result.wall_s,
                            stop_reason="completed")

@@ -367,6 +367,50 @@ def test_fleet_model_parallel_gauges_prometheus_exposition():
         mem.stop()
 
 
+def test_live_weight_version_gauges_prometheus_exposition():
+    """The live-weight rollout is observable end to end: each replica's
+    harvested serving_version lands as ``router_replica<i>_version`` and the
+    canary gate's per-version health as
+    ``serving_version<v>_{requests,errors,latency_p95}`` — a mixed-version
+    fleet mid-rollout is visible from the Prometheus text alone."""
+    from sparkflow_tpu.serving.membership import Membership
+    from sparkflow_tpu.serving.router import CanaryController
+    m = Metrics()
+    mem = Membership(["http://127.0.0.1:1", "http://127.0.0.1:2"], metrics=m)
+    bodies = [
+        {"status": "ok", "queue_depth": 0, "in_flight": 0,
+         "serving_version": 1},
+        {"status": "ok", "queue_depth": 0, "in_flight": 0,
+         "serving_version": 2},  # mid-rollout: this replica swapped first
+    ]
+    for replica, body in zip(mem.replicas, bodies):
+        replica.probe_client.healthz = lambda body=body, **kw: body
+    mem.probe_all()
+    try:
+        assert [r["version"] for r in mem.snapshot()] == [1, 2]
+        ctl = CanaryController(min_requests=10, metrics=m)
+        for _ in range(4):
+            ctl.observe(1, ok=True, latency_ms=2.0)
+        ctl.observe(2, ok=True, latency_ms=3.0)
+        ctl.observe(2, ok=False)
+        ctl.publish_gauges()
+        text = prometheus_text(m)
+        for fam in ("router_replica0_version", "router_replica1_version",
+                    "serving_version1_requests", "serving_version1_errors",
+                    "serving_version1_latency_p95",
+                    "serving_version2_requests", "serving_version2_errors",
+                    "serving_canary_incumbent", "serving_canary_version"):
+            assert f"# TYPE {fam} gauge" in text, fam
+        assert "router_replica0_version 1.0" in text
+        assert "router_replica1_version 2.0" in text
+        assert "serving_version1_requests 4.0" in text
+        assert "serving_version2_errors 1.0" in text
+        assert "serving_canary_incumbent 1.0" in text
+        assert "serving_canary_version 2.0" in text
+    finally:
+        mem.stop()
+
+
 # -- memory watcher ----------------------------------------------------------
 
 def test_memory_watcher_sample_publishes_gauges():
