@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -152,6 +152,18 @@ swap-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_weightstore.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/swap_smoke.py
 	JAX_PLATFORMS=cpu python bench.py --hot-swap
+
+# quantized-KV smoke: the int8/fp8 pool battery (kernel dequant parity,
+# running-scale appends, churn neutrality, composition parity), a
+# real-server int8 smoke (16 concurrent mixed-length greedy generations
+# with spec k=3 + prefix cache + chunked prefill, token-identical to
+# full-precision decode, healthz advertising the pool layout, clean
+# SIGTERM drain), then the capacity/parity/overload benchmark
+# (docs/serving.md)
+kvquant-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_kvquant.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/kvquant_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --kv-quant
 
 # observability smoke: the spans/stepstats/prometheus/request-tracing suite,
 # then the span-overhead micro-bench (docs/observability.md)

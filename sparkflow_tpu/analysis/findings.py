@@ -93,6 +93,11 @@ RULES: Dict[str, Tuple[str, str]] = {
                 "data-dependent cond/while — if devices disagree on the "
                 "predicate, some enter the collective and some don't, and "
                 "the mesh hangs"),
+    "GC-J108": ("full-pool-dequant",
+                "a convert_element_type widens the entire quantized KV page "
+                "pool to float before the page gather — a full-precision "
+                "transient copy of the whole cache that forfeits the memory "
+                "quantization bought; dequantize the gathered pages instead"),
 }
 
 

@@ -63,6 +63,20 @@ GC-J107  collective-        a collective (psum/all_gather/psum_scatter/...)
                             from fully-replicated values) is a legitimate
                             suppression — pass ``ignore=("GC-J107",)`` at
                             that call site.
+GC-J108  full-pool-dequant  a ``convert_element_type`` whose operand is the
+                            WHOLE quantized KV page pool (int8/fp8 operand,
+                            wide-float target, page-pool rank with the
+                            pool's ``num_pages`` in its shape). Dequant
+                            must run on the gathered pages (a few per
+                            slot), never the pool: a full-pool convert
+                            materializes a transient fp copy of the entire
+                            cache, silently forfeiting the memory the
+                            quantization bought — and it scales with pool
+                            size, not batch, so it is invisible at toy
+                            shapes and an OOM at serving shapes. Detected
+                            in :func:`lint_decode_collectives` /
+                            :func:`lint_decode_step` when the caller
+                            supplies ``kv_pool_pages``.
 """
 
 from __future__ import annotations
@@ -539,14 +553,56 @@ def lint_sharding_config(fn: Callable, args: Sequence, sharding, *,
     return findings
 
 
+#: storage dtypes a quantized KV pool can hold (GC-J108 operand gate)
+_QUANT_POOL_DTYPES = ("int8", "float8")
+
+
+def _full_pool_dequant_findings(jaxpr, label: str,
+                                kv_pool_pages: int) -> List[Finding]:
+    """GC-J108: flag convert_element_type eqns that widen a whole quantized
+    page pool to float. The page-gather shrinks the pages axis to a few
+    pages per slot, so a wide convert still carrying ``kv_pool_pages`` in a
+    rank>=4 operand can only be the un-gathered pool."""
+    findings: List[Finding] = []
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        aval = eqn.invars[0].aval
+        src = np.dtype(aval.dtype).name
+        if not src.startswith(_QUANT_POOL_DTYPES):
+            continue
+        new = np.dtype(eqn.params.get("new_dtype"))
+        if not (np.issubdtype(new, np.floating) and new.itemsize >= 2):
+            continue
+        shape = tuple(getattr(aval, "shape", ()))
+        if len(shape) < 4 or kv_pool_pages not in shape:
+            continue
+        findings.append(Finding(
+            "GC-J108",
+            f"{label}: convert_element_type({src} -> {new.name}) over a "
+            f"{shape} operand — the whole quantized KV pool "
+            f"(num_pages={kv_pool_pages}) is being dequantized before the "
+            f"page gather. This materializes a full-precision transient "
+            f"copy of the entire cache (scales with pool size, not batch), "
+            f"forfeiting the memory quantization bought; gather the pages "
+            f"first and dequantize the gathered rows",
+            source="jaxpr_lint",
+            detail={"operand_shape": list(shape), "operand_dtype": src,
+                    "new_dtype": new.name,
+                    "kv_pool_pages": kv_pool_pages}))
+    return findings
+
+
 def lint_decode_collectives(fn: Callable, args: Sequence, *,
                             mesh=None, in_specs=None, out_specs=None,
                             tp_axis: Optional[str] = None,
                             ep_axis: Optional[str] = None,
                             pp_axis: Optional[str] = None,
+                            kv_pool_pages: Optional[int] = None,
                             name: Optional[str] = None,
                             ignore: Sequence[str] = ()) -> List[Finding]:
-    """GC-J106 + GC-J107 over one decode-plane executable body.
+    """GC-J106 + GC-J107 (+ GC-J108 when ``kv_pool_pages`` is given) over
+    one decode-plane executable body.
 
     ``fn`` is the per-shard step function; with ``mesh``/``in_specs`` given
     it is traced under the same shard_map wrapper the engine compiles
@@ -564,9 +620,15 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
       sampled token with a select-psum);
     - an axis NOT declared must not appear — an undeclared collective means
       the compiled program and the config everyone budgets from disagree.
+
+    With ``kv_pool_pages`` given (a quantized-pool engine's total page
+    count), the same jaxpr is additionally scanned for GC-J108
+    ``full-pool-dequant``: any wide-float ``convert_element_type`` whose
+    operand is the whole quantized pool.
     """
     ignore = set(ignore)
-    if {"GC-J106", "GC-J107"} <= ignore:
+    check_j108 = kv_pool_pages is not None and "GC-J108" not in ignore
+    if {"GC-J106", "GC-J107"} <= ignore and not check_j108:
         return []
     label = name or getattr(fn, "__name__", "decode_step")
     args = tuple(jax.tree.map(_struct_like, a) for a in args)
@@ -578,6 +640,9 @@ def lint_decode_collectives(fn: Callable, args: Sequence, *,
     divergence: List[Finding] = []
     if "GC-J107" not in ignore:
         divergence = _divergence_findings(closed.jaxpr, label)
+    if check_j108:
+        divergence = divergence + _full_pool_dequant_findings(
+            closed.jaxpr, label, int(kv_pool_pages))
     if "GC-J106" in ignore:
         return divergence
     observed: set = set()
@@ -647,9 +712,10 @@ def lint_decode_step(engine, *, name: Optional[str] = None,
     trace its steady-state decode step exactly as warmup compiles it (same
     shard_map wrapper and specs when model-parallel) and check the observed
     collectives against the tp/ep/pp axes the engine declares (a pp engine
-    must show the ppermute stage handoff). Zero findings is
-    the repo gate; both planted-defect directions live in
-    ``tests/test_decode.py``."""
+    must show the ppermute stage handoff). A quantized-pool engine
+    (``kv_quant=``) is additionally scanned for GC-J108 full-pool-dequant.
+    Zero findings is the repo gate; both planted-defect directions live in
+    ``tests/test_decode.py`` / ``tests/test_analysis.py``."""
     import jax.numpy as jnp
     B, maxp = engine.num_slots, engine.max_pages_per_slot
     i32 = jnp.int32
@@ -671,6 +737,8 @@ def lint_decode_step(engine, *, name: Optional[str] = None,
         engine._decode_fn, args, mesh=mesh, in_specs=in_specs,
         out_specs=out_specs, tp_axis=engine._tp_axis,
         ep_axis=engine._ep_axis, pp_axis=engine._pp_axis,
+        kv_pool_pages=(engine.kv.num_pages
+                       if getattr(engine, "_quantized", False) else None),
         name=name or (f"decode_step[tp={engine._tp},ep={engine._ep},"
                       f"pp={engine._pp}]"),
         ignore=ignore)

@@ -145,6 +145,8 @@ class RaceTracker:
         self._lock_names: Dict[int, str] = {}   # id() keys stay unambiguous
         self.races: List[Race] = []
         self._prev: Optional[RaceTracker] = None
+        self._serial_mu = threading.Lock()  # guards _next_serial only
+        self._next_serial = 1
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -174,6 +176,19 @@ class RaceTracker:
             held = self._tls.held = {}
         return held
 
+    def _tid(self) -> int:
+        # tracker-assigned per-thread serial, NOT threading.get_ident():
+        # the OS reuses idents, so a worker that fully finishes before its
+        # sibling starts would alias the sibling into the same "thread" and
+        # the field would never leave the exclusive state (missed race)
+        serial = getattr(self._tls, "serial", None)
+        if serial is None:
+            with self._serial_mu:
+                serial = self._next_serial
+                self._next_serial += 1
+            self._tls.serial = serial
+        return serial
+
     def _on_acquire(self, lock: "InstrumentedLock") -> None:
         held = self._held()
         held[id(lock)] = held.get(id(lock), 0) + 1
@@ -197,7 +212,7 @@ class RaceTracker:
                 self._pins.append(obj)
 
     def record(self, obj: object, attr: str, write: bool) -> None:
-        tid = threading.get_ident()
+        tid = self._tid()
         held = frozenset(self._held())
         key = (id(obj), attr)
         with self._mu:

@@ -365,33 +365,73 @@ class TransformerLM(_TransformerBase):
     # param_pspecs()'s tp sharding applies to decode exactly as to training.
 
     def init_decode_cache(self, batch: int, max_len: Optional[int] = None,
-                          dtype=None):
+                          dtype=None, kv_dtype: Optional[str] = None):
         """Dense per-slot KV cache ``{"k","v": [layers, B, heads, L, d]}``
-        for the default :meth:`decode_step` attend."""
+        for the default :meth:`decode_step` attend. With
+        ``kv_dtype="int8"|"fp8"`` the rows store quantized and the cache
+        carries ``k_scale``/``v_scale`` ``[layers, B, heads, L]`` f32
+        per-token-per-head scales — the dense parity twin of the serving
+        engine's quantized page pool (finer scale granularity: dense writes
+        are independent per position, so no running page max is needed)."""
         L = int(max_len) if max_len is not None else self.max_len
-        dt = dtype if dtype is not None else self.compute_dtype
         shape = (self.num_layers, batch, self.num_heads, L, self.head_dim)
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if kv_dtype in (None, "bf16"):
+            dt = dtype if dtype is not None else self.compute_dtype
+            return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        from ..utils import quant
+        store, _ = quant.kv_pool_dtype(kv_dtype)
+        sshape = (self.num_layers, batch, self.num_heads, L)
+        return {"k": jnp.zeros(shape, store), "v": jnp.zeros(shape, store),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
 
     def _dense_cache_attend(self, layer, q, k_new, v_new, cache, pos):
         """Default decode attention: scatter this token's k/v into a dense
         cache at ``pos`` and attend over positions ``<= pos``. q/k/v are
-        ``[B, heads, d]``; ``pos`` is ``[B]`` int32."""
+        ``[B, heads, d]``; ``pos`` is ``[B]`` int32. A quantized cache
+        (``"k_scale" in cache``) stores each row as int8/fp8 with its own
+        per-head scale; the dequant multiplies the gathered rows inside the
+        f32 accumulations, mirroring the paged kernels' contract."""
         import math as _math
+        from ..utils import quant
         b = q.shape[0]
         L = cache["k"].shape[3]
         bidx = jnp.arange(b)
-        k = cache["k"][layer].at[bidx, :, pos].set(k_new.astype(cache["k"].dtype))
-        v = cache["v"][layer].at[bidx, :, pos].set(v_new.astype(cache["v"].dtype))
+        quantized = "k_scale" in cache
+        if quantized:
+            qmax = (127.0 if cache["k"].dtype == jnp.int8 else 448.0)
+
+            def put(rows, scales, new):
+                nf = new.astype(jnp.float32)                  # [B, heads, d]
+                sc = jnp.max(jnp.abs(nf), axis=-1) / qmax     # [B, heads]
+                eff = jnp.where(sc > 0, sc, 1.0)
+                rq = quant.kv_cast(nf / eff[..., None], rows.dtype, qmax)
+                rows = rows[layer].at[bidx, :, pos].set(rq)
+                scales = scales[layer].at[bidx, :, pos].set(sc)
+                return rows, scales
+
+            k, ks = put(cache["k"], cache["k_scale"], k_new)
+            v, vs = put(cache["v"], cache["v_scale"], v_new)
+            kf = k.astype(jnp.float32) * ks[..., None]
+            vf = v.astype(jnp.float32) * vs[..., None]
+        else:
+            k = cache["k"][layer].at[bidx, :, pos].set(
+                k_new.astype(cache["k"].dtype))
+            v = cache["v"][layer].at[bidx, :, pos].set(
+                v_new.astype(cache["v"].dtype))
+            kf = k.astype(jnp.float32)
+            vf = v.astype(jnp.float32)
         scale = 1.0 / _math.sqrt(self.head_dim)
-        s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
+        s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32), kf) * scale
         valid = jnp.arange(L, dtype=jnp.int32)[None, :] <= pos[:, None]
         s = jnp.where(valid[:, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhl,bhld->bhd", p, v.astype(jnp.float32))
-        cache = {"k": cache["k"].at[layer].set(k),
-                 "v": cache["v"].at[layer].set(v)}
+        out = jnp.einsum("bhl,bhld->bhd", p, vf)
+        cache = dict(cache, k=cache["k"].at[layer].set(k),
+                     v=cache["v"].at[layer].set(v))
+        if quantized:
+            cache["k_scale"] = cache["k_scale"].at[layer].set(ks)
+            cache["v_scale"] = cache["v_scale"].at[layer].set(vs)
         return out.astype(q.dtype), cache
 
     # -- stage-level pieces ---------------------------------------------------

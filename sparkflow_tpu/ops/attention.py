@@ -677,8 +677,23 @@ def flash_attention(q, k, v, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
+def _gather_dequant(pages, page_table, scales):
+    """Gather pool pages per slot and (when quantized) apply the
+    per-page-per-head scales: ``[num_pages, page, H, D]`` x ``[B, maxp]``
+    -> ``[B, maxp*page, H, D]`` f32. The dequant convert runs on the
+    GATHERED pages only — converting the whole pool is the GC-J108
+    defect (it silently doubles peak pool memory)."""
+    b, maxp = page_table.shape
+    page, h, d = pages.shape[1:]
+    g = pages[page_table].astype(jnp.float32)   # [B, maxp, page, H, D]
+    if scales is not None:
+        g = g * scales[page_table][:, :, None, :, None]
+    return g.reshape(b, maxp * page, h, d)
+
+
 def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
-                              sm_scale: Optional[float] = None):
+                              sm_scale: Optional[float] = None,
+                              k_scales=None, v_scales=None):
     """Ground-truth decode attention over a paged KV pool, pure jnp.
 
     One query token per slot attends over that slot's cached keys/values,
@@ -693,22 +708,24 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
     - ``lengths``: ``[B]`` int32 — valid tokens per slot; global position
       ``p * page_size + t < lengths[b]`` attends, everything else is
       masked. A slot with ``lengths == 0`` returns exact zeros.
+    - ``k_scales`` / ``v_scales``: optional ``[num_pages, H]`` f32
+      per-page-per-head dequantization scales for an int8/fp8 pool
+      (``row = stored * scale``); pass both or neither.
     """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
     b, h, d = q.shape
-    page = k_pages.shape[1]
-    maxp = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    # gather the slot's whole logical cache: [B, maxp*page, H, D]
-    k = k_pages[page_table].reshape(b, maxp * page, h, d)
-    v = v_pages[page_table].reshape(b, maxp * page, h, d)
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
-                   k.astype(jnp.float32),
+    # gather (and dequantize) the slot's whole logical cache
+    k = _gather_dequant(k_pages, page_table, k_scales)
+    v = _gather_dequant(v_pages, page_table, v_scales)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k,
                    preferred_element_type=jnp.float32) * scale
-    pos = jnp.arange(maxp * page, dtype=jnp.int32)
+    pos = jnp.arange(k.shape[1], dtype=jnp.int32)
     valid = pos[None, :] < lengths[:, None]               # [B, K]
     s = jnp.where(valid[:, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhk,bkhd->bhd", p, v)
     # all-masked rows softmax to uniform garbage; empty slots must be zeros
     out = jnp.where((lengths > 0)[:, None, None], out, 0.0)
     return out.astype(q.dtype)
@@ -761,9 +778,61 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     ).astype(o_ref.dtype)
 
 
+def _paged_kernel_quant(table_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                        vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                        page_size: int, sm_scale: float):
+    """:func:`_paged_kernel` over an int8/fp8 pool: the page's K/V block
+    arrives quantized and its ``[H]`` per-page-per-head scales ride the
+    same scalar-prefetched index map. Dequantization happens INSIDE the
+    accumulations in f32 — the K scale folds into the QK^T scores and the
+    V scale into the PV update — so no full-precision page is ever
+    materialized beyond the one block in VMEM."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    np_ = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [page, H, D] quant
+        v = v_ref[0].astype(jnp.float32)
+        ks = ks_ref[0]                                    # [H] f32
+        vs = vs_ref[0]
+        # s[h, t] = (q[h, :] . k_q[t, h, :]) * k_scale[h] * sm_scale
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                                preferred_element_type=jnp.float32
+                                ) * (ks[:, None] * sm_scale)
+        tpos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(tpos < length, s, NEG_INF)          # ragged last page
+        m_prev = m_ref[:]                                 # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pexp = jnp.exp(s - m_new)                         # [H, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(pexp, axis=1, keepdims=True)
+        # acc[h, d] += (sum_t pexp[h, t] * v_q[t, h, d]) * v_scale[h]
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * vs[:, None]
+        m_ref[:] = m_new
+
+    @pl.when(p == np_ - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
 def paged_attention(q, k_pages, v_pages, page_table, lengths,
                     sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    k_scales=None, v_scales=None):
     """Decode attention kernel: one query token per slot against a
     page-table-indirected K/V pool. Same operands/semantics as
     :func:`paged_attention_reference` (which is its parity ground truth).
@@ -776,34 +845,59 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths,
     length cost no flops. Falls back to the reference (with the same
     ``last_attention_path`` reporting) when the head layout violates the
     TPU tile rules.
+
+    With ``k_scales``/``v_scales`` (``[num_pages, H]`` f32) the pool is
+    int8/fp8 and the kernel dequantizes inside the gather: the scale
+    blocks ride the same scalar-prefetched page-table index map and fold
+    into the QK^T / PV accumulations in f32 — the full-precision pool is
+    never materialized.
     """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
+    quantized = k_scales is not None
     b, h, d = q.shape
     page = k_pages.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None:
         interpret = not on_tpu
-    # compiled blocks are [page, H, D]: sublane dim H % 8, lane dim D % 128
-    # (interpret mode has no tile constraint — CPU parity tests run any shape)
+    # compiled blocks are [page, H, D]: sublane dim H, lane dim D % 128.
+    # The sublane tile depends on the pool dtype — 8 for f32/bf16, 32 for
+    # int8/fp8. (interpret mode has no tile constraint — CPU parity tests
+    # run any shape)
+    sub = 32 if quantized else 8
     tiles_ok = (pltpu is not None
-                and (interpret or (h % 8 == 0 and d % 128 == 0)))
-    if not tiles_ok:
+                and (interpret or (h % sub == 0 and d % 128 == 0)))
+    if not tiles_ok or _FORCE_XLA.get():
         _LAST_PATH.set("reference")
         return paged_attention_reference(q, k_pages, v_pages, page_table,
-                                         lengths, sm_scale=scale)
+                                         lengths, sm_scale=scale,
+                                         k_scales=k_scales,
+                                         v_scales=v_scales)
     _LAST_PATH.set("pallas")
     maxp = page_table.shape[1]
-    kernel = functools.partial(_paged_kernel, page_size=page, sm_scale=scale)
+    page_spec = pl.BlockSpec((1, page, h, d),
+                             lambda bb, p, t, l: (t[bb, p], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda bb, p, t, l: (bb, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        kernel = functools.partial(_paged_kernel_quant, page_size=page,
+                                   sm_scale=scale)
+        scale_spec = pl.BlockSpec((1, h), lambda bb, p, t, l: (t[bb, p], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+    else:
+        kernel = functools.partial(_paged_kernel, page_size=page,
+                                   sm_scale=scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, maxp),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda bb, p, t, l: (bb, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda bb, p, t, l: (t[bb, p], 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda bb, p, t, l: (t[bb, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda bb, p, t, l: (bb, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, d), jnp.float32),   # acc
@@ -820,7 +914,7 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
 
 
 # ---------------------------------------------------------------------------
@@ -829,7 +923,8 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths,
 
 
 def paged_attention_verify_reference(q, k_pages, v_pages, page_table, start,
-                                     sm_scale: Optional[float] = None):
+                                     sm_scale: Optional[float] = None,
+                                     k_scales=None, v_scales=None):
     """Ground-truth multi-position decode attention over a paged KV pool.
 
     The speculative verify step scores ``S = k + 1`` consecutive positions
@@ -845,24 +940,27 @@ def paged_attention_verify_reference(q, k_pages, v_pages, page_table, start,
     - ``start``: ``[B]`` int32 — tokens committed *before* this chunk; query
       ``s`` attends positions ``<= start[b] + s``, so ``S == 1`` degenerates
       to :func:`paged_attention_reference` with ``lengths = start + 1``.
+    - ``k_scales`` / ``v_scales``: optional ``[num_pages, H]`` f32
+      per-page-per-head dequantization scales for an int8/fp8 pool.
 
     Every query attends at least itself, so there is no empty-slot case.
     """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
     b, h, s, d = q.shape
     page = k_pages.shape[1]
     maxp = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    k = k_pages[page_table].reshape(b, maxp * page, h, d)
-    v = v_pages[page_table].reshape(b, maxp * page, h, d)
-    att = jnp.einsum("bhsd,bkhd->bhsk", q.astype(jnp.float32),
-                     k.astype(jnp.float32),
+    k = _gather_dequant(k_pages, page_table, k_scales)
+    v = _gather_dequant(v_pages, page_table, v_scales)
+    att = jnp.einsum("bhsd,bkhd->bhsk", q.astype(jnp.float32), k,
                      preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(maxp * page, dtype=jnp.int32)
     qpos = start[:, None] + jnp.arange(s, dtype=jnp.int32)       # [B, S]
     valid = pos[None, None, :] <= qpos[:, :, None]               # [B, S, K]
     att = jnp.where(valid[:, None, :, :], att, NEG_INF)
     p = jax.nn.softmax(att, axis=-1)
-    out = jnp.einsum("bhsk,bkhd->bhsd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhsk,bkhd->bhsd", p, v)
     return out.astype(q.dtype)
 
 
@@ -914,9 +1012,61 @@ def _paged_verify_kernel(table_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
                     ).astype(o_ref.dtype)
 
 
+def _paged_verify_kernel_quant(table_ref, start_ref, q_ref, k_ref, v_ref,
+                               ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                               *, page_size: int, num_q: int, sm_scale: float):
+    """:func:`_paged_verify_kernel` over an int8/fp8 pool: like
+    :func:`_paged_kernel_quant`, the page's ``[H]`` scales ride the
+    scalar-prefetched index map and fold into the QK^T / PV accumulations
+    in f32 (broadcast over the S query rows)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    np_ = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+
+    @pl.when(p * page_size < start + num_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                  # [H, S, D]
+        k = k_ref[0].astype(jnp.float32)                  # [page, H, D] quant
+        v = v_ref[0].astype(jnp.float32)
+        ks = ks_ref[0]                                    # [H] f32
+        vs = vs_ref[0]
+        # att[h, s, t] = (q[h, s, :] . k_q[t, h, :]) * k_scale[h] * sm_scale
+        att = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (1,))),
+                                  preferred_element_type=jnp.float32
+                                  ) * (ks[:, None, None] * sm_scale)
+        tpos = p * page_size + jax.lax.broadcasted_iota(jnp.int32,
+                                                        att.shape, 2)
+        qpos = start + jax.lax.broadcasted_iota(jnp.int32, att.shape, 1)
+        att = jnp.where(tpos <= qpos, att, NEG_INF)
+        m_prev = m_ref[:]                                 # [H, S, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(att, axis=2, keepdims=True))
+        pexp = jnp.exp(att - m_new)                       # [H, S, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(pexp, axis=2, keepdims=True)
+        # acc[h, s, d] += (sum_t pexp[h, s, t] * v_q[t, h, d]) * v_scale[h]
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            pexp, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * vs[:, None, None]
+        m_ref[:] = m_new
+
+    @pl.when(p == np_ - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
 def paged_attention_verify(q, k_pages, v_pages, page_table, start,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           k_scales=None, v_scales=None):
     """Speculative-verify attention kernel: ``S`` consecutive query positions
     per slot against the page-table-indirected K/V pool, per-query causal.
     Same operands/semantics as :func:`paged_attention_verify_reference`
@@ -925,7 +1075,14 @@ def paged_attention_verify(q, k_pages, v_pages, page_table, start,
     online-softmax state instead of one. Pages wholly past ``start[b] + S``
     cost no flops. Falls back to the reference (reported via
     ``last_attention_path``) when the tile rules are violated.
+
+    ``k_scales``/``v_scales`` (``[num_pages, H]`` f32) select the
+    dequant-on-read kernel for an int8/fp8 pool, exactly like
+    :func:`paged_attention`.
     """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
+    quantized = k_scales is not None
     b, h, s, d = q.shape
     page = k_pages.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -933,29 +1090,43 @@ def paged_attention_verify(q, k_pages, v_pages, page_table, start,
     if interpret is None:
         interpret = not on_tpu
     # compiled q/acc blocks are [H, S, D]: sublane dim S % 8, lane D % 128;
-    # k/v blocks [page, H, D] need H % 8 like the single-query kernel
+    # k/v blocks [page, H, D] need H % 8 like the single-query kernel —
+    # % 32 when the pool is int8/fp8 (dtype-dependent sublane tile)
+    sub = 32 if quantized else 8
     tiles_ok = (pltpu is not None
-                and (interpret or (h % 8 == 0 and d % 128 == 0
+                and (interpret or (h % sub == 0 and d % 128 == 0
                                    and s % 8 == 0)))
-    if not tiles_ok:
+    if not tiles_ok or _FORCE_XLA.get():
         _LAST_PATH.set("reference")
         return paged_attention_verify_reference(q, k_pages, v_pages,
                                                 page_table, start,
-                                                sm_scale=scale)
+                                                sm_scale=scale,
+                                                k_scales=k_scales,
+                                                v_scales=v_scales)
     _LAST_PATH.set("pallas")
     maxp = page_table.shape[1]
-    kernel = functools.partial(_paged_verify_kernel, page_size=page,
-                               num_q=s, sm_scale=scale)
+    page_spec = pl.BlockSpec((1, page, h, d),
+                             lambda bb, p, t, st: (t[bb, p], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h, s, d), lambda bb, p, t, st: (bb, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        kernel = functools.partial(_paged_verify_kernel_quant,
+                                   page_size=page, num_q=s, sm_scale=scale)
+        scale_spec = pl.BlockSpec((1, h), lambda bb, p, t, st: (t[bb, p], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+    else:
+        kernel = functools.partial(_paged_verify_kernel, page_size=page,
+                                   num_q=s, sm_scale=scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, maxp),
-        in_specs=[
-            pl.BlockSpec((1, h, s, d), lambda bb, p, t, st: (bb, 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda bb, p, t, st: (t[bb, p], 0, 0, 0)),
-            pl.BlockSpec((1, page, h, d),
-                         lambda bb, p, t, st: (t[bb, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, s, d),
                                lambda bb, p, t, st: (bb, 0, 0, 0)),
         scratch_shapes=[
@@ -972,7 +1143,7 @@ def paged_attention_verify(q, k_pages, v_pages, page_table, start,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(page_table.astype(jnp.int32), start.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
 
 
 # ---------------------------------------------------------------------------

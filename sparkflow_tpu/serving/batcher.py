@@ -409,6 +409,12 @@ class ContinuousBatcher:
         self._prefilling = 0   # requests popped for prefill, no slot yet
         self._closed = False
         self._draining = False
+        # admission accounting: offered vs refused-at-the-door. The
+        # quantized-pool benchmarks read the rejection RATE off these (a
+        # roomier pool admits more of the same offered load), and capacity
+        # dashboards get them without scraping the metrics registry.
+        self._submitted = 0
+        self._rejected = 0
         self._workers = [threading.Thread(target=self._decode_loop,
                                           name="continuous-batcher",
                                           daemon=True)]
@@ -446,12 +452,15 @@ class ContinuousBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError("ContinuousBatcher is closed")
+            self._submitted += 1
             if self._draining:
+                self._rejected += 1
                 self.metrics.incr("serving/drain_rejections")
                 raise Draining("ContinuousBatcher is draining; in-flight "
                                "generations complete but new requests are "
                                "refused")
             if len(self._pending) >= self.max_queue:
+                self._rejected += 1
                 self.metrics.incr("serving/queue_rejections")
                 raise QueueFull(
                     f"generate queue at capacity ({len(self._pending)}/"
@@ -529,6 +538,23 @@ class ContinuousBatcher:
         flight) — the replica load signal ``/healthz`` exposes."""
         with self._lock:
             return len(self._active) + self._prefilling
+
+    def stats(self) -> Dict[str, Any]:
+        """Admission accounting: offered load vs refused-at-the-door, plus
+        the engine's pool layout so capacity benchmarks correlate the
+        rejection rate with bytes-per-page in one read."""
+        with self._lock:
+            submitted, rejected = self._submitted, self._rejected
+            depth = len(self._pending)
+            inflight = len(self._active) + self._prefilling
+        return {
+            "submitted": submitted,
+            "rejected": rejected,
+            "rejection_rate": rejected / submitted if submitted else 0.0,
+            "queue_depth": depth,
+            "inflight_rows": inflight,
+            "kv_quant": getattr(self.engine, "kv_quant", "bf16"),
+        }
 
     # -- worker side ---------------------------------------------------------
 

@@ -107,6 +107,7 @@ from ..obs.spans import span as obs_span
 from ..resilience import faults
 from ..ops import paged_attention, paged_attention_verify
 from ..utils import metrics as metrics_mod
+from ..utils import quant
 from ..utils.tracing import annotate
 from ..sharding import per_device_bytes
 from .kvcache import OutOfPages, PagedKVCache
@@ -199,6 +200,20 @@ class DecodeEngine:
         bubble survives only at drain/refill edges. ``False`` keeps the
         single-wave staged step (all slots traverse all stages per call —
         same tokens, ``(pp-1)/pp`` of the mesh idle at any instant).
+    kv_quant : str | None
+        Pool element layout: ``None``/``"bf16"`` keeps the compute-dtype
+        pool; ``"int8"`` / ``"fp8"`` store quantized rows plus a
+        per-page-per-head f32 scale tensor kept alongside the page tables
+        — roughly 2x (int8 vs bf16) the concurrent sessions per device.
+        Every attend gathers quantized pages and dequantizes INSIDE the
+        kernel accumulations (:func:`~sparkflow_tpu.ops.paged_attention`
+        with ``k_scales``/``v_scales``); writes quantize at append time
+        with a running per-page absmax. Composes with tp (scales shard on
+        heads), pp (scales shard on layers), speculation (rollback
+        ``truncate`` returns quantized pages to the reservation unchanged)
+        and prefix/COW sharing (aliased table entries gather the same
+        quantized rows) — same AOT shape count, zero steady-state
+        retraces.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -210,6 +225,7 @@ class DecodeEngine:
                  spec_k: int = 0, draft_layers: Optional[int] = None,
                  draft_model=None, draft_params=None,
                  mesh=None, sharding=None, pp_wave: bool = True,
+                 kv_quant: Optional[str] = None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -298,8 +314,32 @@ class DecodeEngine:
         self.max_pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
         if num_pages is None:
             num_pages = self.num_slots * self.max_pages_per_slot + 1
+        # quantized-pool layout: validated here (construction) so a
+        # misconfigured replica fails fast, not at first decode
+        self.kv_quant = ("bf16" if kv_quant in (None, "bf16")
+                         else str(kv_quant))
+        if self.kv_quant not in quant.KV_DTYPES:
+            raise ValueError(f"kv_quant must be one of {quant.KV_DTYPES} or "
+                             f"None, got {kv_quant!r}")
+        if not quant.kv_quant_supported(self.kv_quant):
+            raise ValueError(
+                "kv_quant='fp8' needs jax.numpy.float8_e4m3fn, which this "
+                "jax/ml_dtypes install does not expose; use 'int8'")
+        self._quantized = self.kv_quant != "bf16"
+        self._kv_quant_error = None  # warmup probe: max |logit delta| vs bf16
+        # device bytes one page costs across K + V (+ scales) and all
+        # layers: the fleet surface routes on BYTE headroom, not raw page
+        # counts, so replicas with different pool layouts compare fairly
+        _cdt = (model.compute_dtype if model.compute_dtype is not None
+                else jnp.float32)
+        _item = 1 if self._quantized else np.dtype(_cdt).itemsize
+        self._kv_bytes_per_page = 2 * int(model.num_layers) * (
+            self.page_size * int(model.num_heads) * int(model.head_dim)
+            * _item + (int(model.num_heads) * 4 if self._quantized else 0))
         self.kv = PagedKVCache(num_pages, self.page_size, self.num_slots,
-                               self.max_pages_per_slot, metrics=self.metrics)
+                               self.max_pages_per_slot, metrics=self.metrics,
+                               kv_dtype=self.kv_quant,
+                               kv_bytes_per_page=self._kv_bytes_per_page)
         self.max_top_k = max(1, min(int(max_top_k), int(model.vocab_size)))
         # prompts pad to page-aligned buckets; the ladder top also caps
         # admissible prompt length
@@ -397,15 +437,45 @@ class DecodeEngine:
         # layout-blind either way.
         pool_shape = (model.num_layers, num_pages, self.page_size,
                       model.num_heads, model.head_dim)
-        self._pool_spec = (P(self._pp_axis, None, None, self._tp_axis, None)
-                           if (self._tp_axis or self._pp_axis) else P())
-        if self._sharded:
-            ns = NamedSharding(self.mesh, self._pool_spec)
-            self._k_pool = jax.device_put(jnp.zeros(pool_shape, pool_dtype), ns)
-            self._v_pool = jax.device_put(jnp.zeros(pool_shape, pool_dtype), ns)
+        rows_spec = (P(self._pp_axis, None, None, self._tp_axis, None)
+                     if (self._tp_axis or self._pp_axis) else P())
+        if self._quantized:
+            # quantized pool: each pool becomes a (rows, scales) pytree —
+            # int8/fp8 rows in the page layout plus [layers, pages, heads]
+            # f32 scales. quant + tp shards the scales on HEADS with the
+            # rows' heads axis; quant + pp shards them on LAYERS with the
+            # stage split — the scale for a page-head always lives on the
+            # shard that gathers those rows. Every AOT signature below is
+            # positionally unchanged (the pool argument is just a pytree).
+            store_dtype, _ = quant.kv_pool_dtype(self.kv_quant)
+            scale_shape = (model.num_layers, num_pages, model.num_heads)
+            scale_spec = (P(self._pp_axis, None, self._tp_axis)
+                          if (self._tp_axis or self._pp_axis) else P())
+            self._pool_spec = (rows_spec, scale_spec)
+
+            def _mk_pool():
+                rows = jnp.zeros(pool_shape, store_dtype)
+                scales = jnp.zeros(scale_shape, jnp.float32)
+                if self._sharded:
+                    rows = jax.device_put(
+                        rows, NamedSharding(self.mesh, rows_spec))
+                    scales = jax.device_put(
+                        scales, NamedSharding(self.mesh, scale_spec))
+                return (rows, scales)
+
+            self._k_pool = _mk_pool()
+            self._v_pool = _mk_pool()
         else:
-            self._k_pool = jnp.zeros(pool_shape, pool_dtype)
-            self._v_pool = jnp.zeros(pool_shape, pool_dtype)
+            self._pool_spec = rows_spec
+            if self._sharded:
+                ns = NamedSharding(self.mesh, self._pool_spec)
+                self._k_pool = jax.device_put(
+                    jnp.zeros(pool_shape, pool_dtype), ns)
+                self._v_pool = jax.device_put(
+                    jnp.zeros(pool_shape, pool_dtype), ns)
+            else:
+                self._k_pool = jnp.zeros(pool_shape, pool_dtype)
+                self._v_pool = jnp.zeros(pool_shape, pool_dtype)
         if self._draft_model is not None:
             dm = self._draft_model
             # dense per-slot draft cache: positions can reach
@@ -561,6 +631,56 @@ class DecodeEngine:
         tok = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
         return tok, nxt
 
+    # -- pool-layout helpers -------------------------------------------------
+    #
+    # With kv_quant on, each pool is a (rows int8/fp8, scales f32) pytree;
+    # these keep the attend closures layout-agnostic. The branch is on a
+    # python bool fixed at construction, so each engine traces exactly one
+    # layout — no data-dependent control flow enters the jaxprs.
+
+    def _kv_rows(self, pool, layer, pids, offs, rows):
+        """Scatter token rows at ``(layer, pids, offs)``; any batch shape.
+        Quantized pools maintain the running per-page-per-head scale."""
+        if self._quantized:
+            return quant.paged_quant_append(pool[0], pool[1], layer,
+                                            pids, offs, rows)
+        return pool.at[layer, pids, offs].set(rows.astype(pool.dtype))
+
+    def _kv_pages(self, pool, layer, page_ids, pages):
+        """Commit whole pages at ``(layer, page_ids)`` (ladder prefill)."""
+        if self._quantized:
+            return quant.paged_quant_write_pages(pool[0], pool[1], layer,
+                                                 page_ids, pages)
+        return pool.at[layer, page_ids].set(pages.astype(pool.dtype))
+
+    def _kv_heads(self, pool):
+        """``(local heads, head_dim)`` of a pool regardless of layout."""
+        a = pool[0] if self._quantized else pool
+        return a.shape[-2], a.shape[-1]
+
+    def _kv_gather(self, pool, layer, page_ids):
+        """Gather pages to f32 rows ``[..., page, heads, d]``, dequantizing
+        the gathered rows only (never the whole pool — GC-J108)."""
+        if self._quantized:
+            return quant.paged_quant_gather(pool[0], pool[1], layer,
+                                            page_ids)
+        return pool[layer, page_ids].astype(jnp.float32)
+
+    def _paged_att(self, q, kp, vp, layer, table, lengths):
+        if self._quantized:
+            return paged_attention(q, kp[0][layer], vp[0][layer], table,
+                                   lengths, k_scales=kp[1][layer],
+                                   v_scales=vp[1][layer])
+        return paged_attention(q, kp[layer], vp[layer], table, lengths)
+
+    def _paged_verify_att(self, q, kp, vp, layer, table, start):
+        if self._quantized:
+            return paged_attention_verify(q, kp[0][layer], vp[0][layer],
+                                          table, start,
+                                          k_scales=kp[1][layer],
+                                          v_scales=vp[1][layer])
+        return paged_attention_verify(q, kp[layer], vp[layer], table, start)
+
     def _decode_fn(self, params, k_pool, v_pool, token, pos, table, keys,
                    temp, topk):
         page = self.page_size
@@ -570,9 +690,9 @@ class DecodeEngine:
             kp, vp = cache
             page_ids = table[bidx, p // page]
             off = p % page
-            kp = kp.at[layer, page_ids, off].set(k_new.astype(kp.dtype))
-            vp = vp.at[layer, page_ids, off].set(v_new.astype(vp.dtype))
-            out = paged_attention(q, kp[layer], vp[layer], table, p + 1)
+            kp = self._kv_rows(kp, layer, page_ids, off, k_new)
+            vp = self._kv_rows(vp, layer, page_ids, off, v_new)
+            out = self._paged_att(q, kp, vp, layer, table, p + 1)
             return out.astype(q.dtype), (kp, vp)
 
         logits, (k_pool, v_pool) = self.model.decode_step(
@@ -600,8 +720,8 @@ class DecodeEngine:
                     npages, page, k.shape[1], k.shape[3])
                 vv = jnp.transpose(v[0], (1, 0, 2)).reshape(
                     npages, page, v.shape[1], v.shape[3])
-                k_pool = k_pool.at[i, page_ids].set(kk.astype(k_pool.dtype))
-                v_pool = v_pool.at[i, page_ids].set(vv.astype(v_pool.dtype))
+                k_pool = self._kv_pages(k_pool, i, page_ids, kk)
+                v_pool = self._kv_pages(v_pool, i, page_ids, vv)
             return logits, k_pool, v_pool
 
         return prefill
@@ -622,25 +742,27 @@ class DecodeEngine:
         def suffix_prefill(params, k_pool, v_pool, ids, start, valid, ctable):
             def attend(layer, q, k_new, v_new, cache, st):
                 kp, vp = cache
-                heads, hd = kp.shape[-2], kp.shape[-1]         # local under tp
+                heads, hd = self._kv_heads(kp)                 # local under tp
                 pos_abs = st[0] + j                            # [C] absolute
                 pids = ctable[jnp.clip(pos_abs // page, 0, maxp - 1)]
                 pids = jnp.where(j < valid[0], pids, 0)        # pad -> scratch
                 off = pos_abs % page
                 kc = jnp.transpose(k_new[0], (1, 0, 2))        # [C, heads, d]
                 vc = jnp.transpose(v_new[0], (1, 0, 2))
-                kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
-                vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
+                kp = self._kv_rows(kp, layer, pids, off, kc)
+                vp = self._kv_rows(vp, layer, pids, off, vc)
                 # gather the row's pages in logical order: element l of the
                 # flattened gather sits at absolute position l
-                hk = kp[layer, ctable].reshape(maxp * page, heads, hd)
-                hv = vp[layer, ctable].reshape(maxp * page, heads, hd)
+                hk = self._kv_gather(kp, layer, ctable).reshape(
+                    maxp * page, heads, hd)
+                hv = self._kv_gather(vp, layer, ctable).reshape(
+                    maxp * page, heads, hd)
                 s = jnp.einsum("hcd,lhd->hcl", q[0].astype(jnp.float32),
-                               hk.astype(jnp.float32)) * scale
+                               hk) * scale
                 ok = tpos[None, :] <= pos_abs[:, None]         # causal [C, L]
                 s = jnp.where(ok[None, :, :], s, -1e30)
                 p = jax.nn.softmax(s, axis=-1)
-                out = jnp.einsum("hcl,lhd->hcd", p, hv.astype(jnp.float32))
+                out = jnp.einsum("hcl,lhd->hcd", p, hv)
                 return out[None].astype(q.dtype), (kp, vp)
 
             logits, (k_pool, v_pool) = model.prefill_suffix(
@@ -687,9 +809,9 @@ class DecodeEngine:
                 pids = table[bidx, jnp.clip(p // page, 0, maxp - 1)]
                 pids = jnp.where(p < writable, pids, 0)
                 off = p % page
-                kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
-                vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
-                out = paged_attention(q, kp[layer], vp[layer], table, p + 1)
+                kp = self._kv_rows(kp, layer, pids, off, k_new)
+                vp = self._kv_rows(vp, layer, pids, off, v_new)
+                out = self._paged_att(q, kp, vp, layer, table, p + 1)
                 return out.astype(q.dtype), (kp, vp)
 
             toks, tok = [], token
@@ -784,10 +906,9 @@ class DecodeEngine:
                 off = pos_abs % page
                 kc = jnp.transpose(k_new, (0, 2, 1, 3))    # [B, S, heads, d]
                 vc = jnp.transpose(v_new, (0, 2, 1, 3))
-                kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
-                vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
-                out = paged_attention_verify(q, kp[layer], vp[layer],
-                                             table, st)
+                kp = self._kv_rows(kp, layer, pids, off, kc)
+                vp = self._kv_rows(vp, layer, pids, off, vc)
+                out = self._paged_verify_att(q, kp, vp, layer, table, st)
                 return out.astype(q.dtype), (kp, vp)
 
             logits, (k_pool, v_pool) = model.decode_verify(
@@ -804,10 +925,11 @@ class DecodeEngine:
         layers). Compiled once at warmup; reached only when a truncate
         crosses into a shared page, which in-engine rollback provably never
         does (the floor is past the shared prompt) — kept so even the
-        pathological path cannot retrace steady state."""
-        k_pool = k_pool.at[:, dst].set(k_pool[:, src])
-        v_pool = v_pool.at[:, dst].set(v_pool[:, src])
-        return k_pool, v_pool
+        pathological path cannot retrace steady state. Axis 1 is the pages
+        axis of both the row tensors and the quantized scale planes, so one
+        tree.map clones rows AND scales."""
+        cp = lambda a: a.at[:, dst].set(a[:, src])
+        return jax.tree.map(cp, k_pool), jax.tree.map(cp, v_pool)
 
     # -- pipeline-parallel staged builders -----------------------------------
     #
@@ -861,10 +983,9 @@ class DecodeEngine:
                     kp, vp = cache
                     pids = jnp.where(_active, table[bidx, p // page], 0)
                     off = p % page
-                    kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
-                    vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
-                    out = paged_attention(q, kp[layer], vp[layer], table,
-                                          p + 1)
+                    kp = self._kv_rows(kp, layer, pids, off, k_new)
+                    vp = self._kv_rows(vp, layer, pids, off, v_new)
+                    out = self._paged_att(q, kp, vp, layer, table, p + 1)
                     return out.astype(q.dtype), (kp, vp)
 
                 y = x
@@ -910,8 +1031,8 @@ class DecodeEngine:
                         npages, page, k.shape[1], k.shape[3])
                     vv = jnp.transpose(v[0], (1, 0, 2)).reshape(
                         npages, page, v.shape[1], v.shape[3])
-                    k_pool = k_pool.at[jl, pids].set(kk.astype(k_pool.dtype))
-                    v_pool = v_pool.at[jl, pids].set(vv.astype(v_pool.dtype))
+                    k_pool = self._kv_pages(k_pool, jl, pids, kk)
+                    v_pool = self._kv_pages(v_pool, jl, pids, vv)
                 x = jnp.where(active, y, x)
             logits = model.head_last(shared, x, lengths=length)
             logits = jax.lax.psum(
@@ -944,7 +1065,7 @@ class DecodeEngine:
                 def attend(layer, q, k_new, v_new, cache, st,
                            _active=active):
                     kp, vp = cache
-                    heads, hd = kp.shape[-2], kp.shape[-1]     # local heads
+                    heads, hd = self._kv_heads(kp)             # local heads
                     pos_abs = st[0] + j
                     pids = ctable[jnp.clip(pos_abs // page, 0, maxp - 1)]
                     pids = jnp.where(j < valid[0], pids, 0)
@@ -952,18 +1073,19 @@ class DecodeEngine:
                     off = pos_abs % page
                     kc = jnp.transpose(k_new[0], (1, 0, 2))
                     vc = jnp.transpose(v_new[0], (1, 0, 2))
-                    kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
-                    vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
-                    hk = kp[layer, ctable].reshape(maxp * page, heads, hd)
-                    hv = vp[layer, ctable].reshape(maxp * page, heads, hd)
+                    kp = self._kv_rows(kp, layer, pids, off, kc)
+                    vp = self._kv_rows(vp, layer, pids, off, vc)
+                    hk = self._kv_gather(kp, layer, ctable).reshape(
+                        maxp * page, heads, hd)
+                    hv = self._kv_gather(vp, layer, ctable).reshape(
+                        maxp * page, heads, hd)
                     sc = jnp.einsum("hcd,lhd->hcl",
                                     q[0].astype(jnp.float32),
-                                    hk.astype(jnp.float32)) * scale
+                                    hk) * scale
                     ok = tpos[None, :] <= pos_abs[:, None]
                     sc = jnp.where(ok[None, :, :], sc, -1e30)
                     pr = jax.nn.softmax(sc, axis=-1)
-                    out = jnp.einsum("hcl,lhd->hcd", pr,
-                                     hv.astype(jnp.float32))
+                    out = jnp.einsum("hcl,lhd->hcd", pr, hv)
                     return out[None].astype(q.dtype), (kp, vp)
 
                 y = x
@@ -1012,10 +1134,9 @@ class DecodeEngine:
                     off = pos_abs % page
                     kc = jnp.transpose(k_new, (0, 2, 1, 3))
                     vc = jnp.transpose(v_new, (0, 2, 1, 3))
-                    kp = kp.at[layer, pids, off].set(kc.astype(kp.dtype))
-                    vp = vp.at[layer, pids, off].set(vc.astype(vp.dtype))
-                    out = paged_attention_verify(q, kp[layer], vp[layer],
-                                                 table, st)
+                    kp = self._kv_rows(kp, layer, pids, off, kc)
+                    vp = self._kv_rows(vp, layer, pids, off, vc)
+                    out = self._paged_verify_att(q, kp, vp, layer, table, st)
                     return out.astype(q.dtype), (kp, vp)
 
                 y = x
@@ -1071,12 +1192,10 @@ class DecodeEngine:
                         pids = jnp.where(pq < writable, pids, 0)
                         pids = jnp.where(_active, pids, 0)
                         off = pq % page
-                        kp = kp.at[layer, pids, off].set(
-                            k_new.astype(kp.dtype))
-                        vp = vp.at[layer, pids, off].set(
-                            v_new.astype(vp.dtype))
-                        out = paged_attention(q, kp[layer], vp[layer],
-                                              table, pq + 1)
+                        kp = self._kv_rows(kp, layer, pids, off, k_new)
+                        vp = self._kv_rows(vp, layer, pids, off, v_new)
+                        out = self._paged_att(q, kp, vp, layer, table,
+                                              pq + 1)
                         return out.astype(q.dtype), (kp, vp)
 
                     y = x
@@ -1132,9 +1251,9 @@ class DecodeEngine:
                 kp, vp = cache
                 pids = tab_w[widx, p // page]
                 off = p % page
-                kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
-                vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
-                out = paged_attention(q, kp[layer], vp[layer], tab_w, p + 1)
+                kp = self._kv_rows(kp, layer, pids, off, k_new)
+                vp = self._kv_rows(vp, layer, pids, off, v_new)
+                out = self._paged_att(q, kp, vp, layer, tab_w, p + 1)
                 return out.astype(q.dtype), (kp, vp)
 
             for jl in range(per):
@@ -1159,7 +1278,8 @@ class DecodeEngine:
             else jax.ShapeDtypeStruct(a.shape, a.dtype), self._params)
 
     def _pool_struct(self):
-        return jax.ShapeDtypeStruct(self._k_pool.shape, self._k_pool.dtype)
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._k_pool)
 
     def _aot(self, fn, donate, arg_structs, specs=None, out_specs=None):
         """jit -> lower -> compile one decode-plane executable. With model
@@ -1187,6 +1307,77 @@ class DecodeEngine:
         recompile regression (GC-R401)."""
         with self._lock:
             self._warmup_locked()
+
+    def _kv_quant_error_probe_locked(self) -> None:
+        """Warmup-time error sample for the ``decode/kv_quant_error`` gauge:
+        forward one synthetic page-length prompt eagerly, commit its K/V to
+        a tiny throwaway pool twice (bf16-reference and quantized layouts),
+        run one decode-attend through each, and record the max abs logit
+        delta. Hermetic — real pools, executables and the RecompileGuard
+        are untouched; any failure degrades to gauge-absent, never to a
+        failed warmup."""
+        try:
+            model, page = self.model, self.page_size
+            store_dtype, _ = quant.kv_pool_dtype(self.kv_quant)
+            ref_dt = (model.compute_dtype if model.compute_dtype is not None
+                      else jnp.float32)
+            n = page
+            rng = np.random.default_rng(0)
+            ids = jnp.asarray(
+                rng.integers(0, model.vocab_size, (1, n)), jnp.int32)
+            logits, kvs = model.prefill(self._params, ids,
+                                        lengths=jnp.asarray([n], jnp.int32))
+            L = len(kvs)
+            h, d = kvs[0][0].shape[1], kvs[0][0].shape[3]
+            kr = jnp.zeros((L, 3, page, h, d), ref_dt)
+            vr = jnp.zeros((L, 3, page, h, d), ref_dt)
+            kq = (jnp.zeros((L, 3, page, h, d), store_dtype),
+                  jnp.zeros((L, 3, h), jnp.float32))
+            vq = (jnp.zeros((L, 3, page, h, d), store_dtype),
+                  jnp.zeros((L, 3, h), jnp.float32))
+            pid = jnp.asarray([1], jnp.int32)
+            for i, (k, v) in enumerate(kvs):
+                kk = jnp.transpose(k[0], (1, 0, 2))[None]  # [1, page, h, d]
+                vv = jnp.transpose(v[0], (1, 0, 2))[None]
+                kr = kr.at[i, pid].set(kk.astype(ref_dt))
+                vr = vr.at[i, pid].set(vv.astype(ref_dt))
+                kq = quant.paged_quant_write_pages(kq[0], kq[1], i, pid, kk)
+                vq = quant.paged_quant_write_pages(vq[0], vq[1], i, pid, vv)
+            table = jnp.asarray([[1, 2]], jnp.int32)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pos = jnp.asarray([n], jnp.int32)
+            bidx = jnp.arange(1)
+
+            def attend_ref(layer, q, k_new, v_new, cache, p):
+                kp, vp = cache
+                pids, off = table[bidx, p // page], p % page
+                kp = kp.at[layer, pids, off].set(k_new.astype(kp.dtype))
+                vp = vp.at[layer, pids, off].set(v_new.astype(vp.dtype))
+                out = paged_attention(q, kp[layer], vp[layer], table, p + 1)
+                return out.astype(q.dtype), (kp, vp)
+
+            def attend_q(layer, q, k_new, v_new, cache, p):
+                kp, vp = cache
+                pids, off = table[bidx, p // page], p % page
+                kp = quant.paged_quant_append(kp[0], kp[1], layer, pids,
+                                              off, k_new)
+                vp = quant.paged_quant_append(vp[0], vp[1], layer, pids,
+                                              off, v_new)
+                out = paged_attention(q, kp[0][layer], vp[0][layer], table,
+                                      p + 1, k_scales=kp[1][layer],
+                                      v_scales=vp[1][layer])
+                return out.astype(q.dtype), (kp, vp)
+
+            lg_ref, _ = model.decode_step(self._params, (kr, vr), tok, pos,
+                                          attend=attend_ref)
+            lg_q, _ = model.decode_step(self._params, (kq, vq), tok, pos,
+                                        attend=attend_q)
+            err = float(jnp.max(jnp.abs(
+                lg_q.astype(jnp.float32) - lg_ref.astype(jnp.float32))))
+            self._kv_quant_error = err
+            self.metrics.gauge("decode/kv_quant_error", err)
+        except Exception:  # pragma: no cover - diagnostics only
+            self._kv_quant_error = None
 
     def _warmup_locked(self) -> None:
         guard = self.recompile_guard
@@ -1282,6 +1473,9 @@ class DecodeEngine:
             self.aot_compiles += 1
         if self.spec_k:
             self._warmup_spec_locked(ps, pool, B, maxp)
+        if self._quantized and self._kv_quant_error is None \
+                and not self._sharded:
+            self._kv_quant_error_probe_locked()
         guard.mark_steady()
 
     def _warmup_spec_locked(self, ps, pool, B: int, maxp: int) -> None:
@@ -1935,6 +2129,8 @@ class DecodeEngine:
                 "serving_version": self._serving_version,
                 "swaps": self._swaps,
                 "pending_swap": self._pending_swap is not None,
+                "kv_quant": self.kv_quant,
+                "kv_quant_error": self._kv_quant_error,
                 "spec": {
                     "enabled": bool(self.spec_k),
                     "k": self.spec_k,
@@ -1963,9 +2159,9 @@ class DecodeEngine:
                     "stages": self._pp,
                     "pp_wave": self._pp_wave,
                     "wave_ticks": self._tick,
-                    "kv_bytes_per_device": (
-                        per_device_bytes(self._k_pool)
-                        + per_device_bytes(self._v_pool)),
+                    "kv_bytes_per_device": sum(
+                        per_device_bytes(leaf) for leaf in
+                        jax.tree.leaves((self._k_pool, self._v_pool))),
                     "param_bytes_per_device": sum(
                         per_device_bytes(leaf) for leaf in
                         jax.tree.leaves(self._params)),

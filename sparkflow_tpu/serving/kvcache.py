@@ -81,6 +81,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..utils import metrics as metrics_mod
+from ..utils import quant
 
 __all__ = ["PagedKVCache", "OutOfPages"]
 
@@ -106,21 +107,41 @@ class PagedKVCache:
     max_pages_per_slot : int
         Page-table width — caps any single sequence at
         ``max_pages_per_slot * page_size`` tokens.
+    kv_dtype : str
+        Device pool element layout — ``"bf16"`` (full precision), ``"int8"``
+        or ``"fp8"``. Pure metadata here: page ids, refcounts, COW and the
+        prefix trie are byte-layout-blind (aliased table entries gather the
+        same quantized rows), so the manager only records the layout for
+        capacity accounting (``stats()["kv_dtype"]`` / gauges) and fleet
+        headroom comparison.
+    kv_bytes_per_page : int, optional
+        Device bytes one page costs across all layers (K + V + scales), as
+        measured by the engine from the actual pool tensors. Exported so
+        routing can compare *byte* headroom across replicas with different
+        pool layouts.
     """
 
     def __init__(self, num_pages: int, page_size: int, num_slots: int,
                  max_pages_per_slot: int,
-                 metrics: Optional[metrics_mod.Metrics] = None):
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 kv_dtype: str = "bf16",
+                 kv_bytes_per_page: Optional[int] = None):
         if num_pages < 2:
             raise ValueError(f"num_pages must be >= 2 (page 0 is scratch), "
                              f"got {num_pages}")
         if page_size < 1 or num_slots < 1 or max_pages_per_slot < 1:
             raise ValueError("page_size, num_slots, max_pages_per_slot must "
                              "be >= 1")
+        if kv_dtype not in quant.KV_DTYPES:
+            raise ValueError(f"kv_dtype must be one of {quant.KV_DTYPES}, "
+                             f"got {kv_dtype!r}")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
+        self.kv_dtype = kv_dtype
+        self.kv_bytes_per_page = (int(kv_bytes_per_page)
+                                  if kv_bytes_per_page is not None else None)
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
         self._lock = threading.Lock()
         # page 0 is scratch: never allocated, absorbs inactive slots' writes
@@ -530,6 +551,13 @@ class PagedKVCache:
         self.metrics.gauge("decode/fragmentation", frag)
         self.metrics.gauge("decode/prefix_hit_rate", hit_rate)
         self.metrics.gauge("decode/tokens_saved", self._tokens_saved)
+        # quantized-capacity surface: the dtype exports as its KV_DTYPES
+        # index so exposition stays numeric (0=bf16, 1=int8, 2=fp8)
+        self.metrics.gauge("serving/kv/dtype_code",
+                           quant.KV_DTYPES.index(self.kv_dtype))
+        if self.kv_bytes_per_page is not None:
+            self.metrics.gauge("serving/kv/bytes_per_page",
+                               self.kv_bytes_per_page)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -554,4 +582,6 @@ class PagedKVCache:
                                     if self._prefix_lookups else 0.0),
                 "prefix_blocks_indexed": len(self._prefix_index),
                 "tokens_saved": self._tokens_saved,
+                "kv_dtype": self.kv_dtype,
+                "kv_bytes_per_page": self.kv_bytes_per_page,
             }

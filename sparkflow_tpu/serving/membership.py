@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from urllib.parse import urlsplit
 
 from ..utils import metrics as metrics_mod
+from ..utils import quant
 from .client import ConnectionPool, ServingClient, ServingError
 
 __all__ = ["BreakerState", "CircuitBreaker", "Replica", "Membership"]
@@ -176,6 +177,13 @@ class Replica:
         # unknown (no decode plane on the replica, or not yet probed)
         self.decode_free_slots = -1
         self.decode_pages_free = -1
+        # quantized-pool layout from /healthz: pool storage dtype and the
+        # replica-total bytes one page costs (K+V+scales, all layers).
+        # Effective-capacity routing multiplies pages_free by this, so a
+        # bf16 replica and an int8 replica with equal page counts compare
+        # by the bytes they can actually still hold. -1 = unknown.
+        self.kv_dtype = "bf16"
+        self.kv_bytes_per_page = -1
         # speculative-decode acceptance rate from /healthz; -1 = speculation
         # off on the replica (or not yet probed)
         self.decode_spec_accept_rate = -1.0
@@ -298,6 +306,12 @@ class Membership:
                     replica.tp = int(dec.get("tp", 1) or 1)
                     replica.ep = int(dec.get("ep", 1) or 1)
                     replica.pp = int(dec.get("pp", 1) or 1)
+                    replica.kv_dtype = str(dec.get("kv_dtype") or "bf16")
+                    try:
+                        replica.kv_bytes_per_page = int(
+                            dec.get("kv_bytes_per_page") or -1)
+                    except (TypeError, ValueError):
+                        replica.kv_bytes_per_page = -1
                 else:
                     replica.decode_free_slots = -1
                     replica.decode_pages_free = -1
@@ -306,6 +320,8 @@ class Membership:
                     replica.tp = 1
                     replica.ep = 1
                     replica.pp = 1
+                    replica.kv_dtype = "bf16"
+                    replica.kv_bytes_per_page = -1
         if ok:
             # a live /healthz is recovery evidence: without it an ejected
             # replica on an idle fleet stays OPEN forever, because half-open
@@ -336,15 +352,24 @@ class Membership:
         admission. Page- or slot-starved replicas sort last (still
         dispatchable as a last resort: replica-side admission turns it into
         explicit backpressure), the rest order by router in-flight then most
-        pages free; replicas with unknown headroom (-1) sort after known
-        ones at equal in-flight."""
+        EFFECTIVE capacity free: pages_free weighted by the replica's
+        ``kv_bytes_per_page``, so a mixed bf16/int8 fleet compares the
+        bytes each replica can still hold, not raw page counts (an int8
+        replica's page holds the same tokens in half the bytes — equal
+        pages_free means it is the roomier target and its probe reports
+        ~2x the page count for the same device budget). Replicas that have
+        not reported a byte figure weight 1 (raw pages); unknown headroom
+        (-1) sorts after known ones at equal in-flight."""
         skip = set(id(r) for r in exclude)
 
         if signal == "generate":
             def key(r):
                 starved = 1 if (r.decode_pages_free == 0
                                 or r.decode_free_slots == 0) else 0
-                return (starved, r.inflight, -r.decode_pages_free, r.index)
+                bpp = r.kv_bytes_per_page if r.kv_bytes_per_page > 0 else 1
+                free = (r.decode_pages_free * bpp
+                        if r.decode_pages_free > 0 else r.decode_pages_free)
+                return (starved, r.inflight, -free, r.index)
         else:
             def key(r):
                 return (r.inflight, r.queue_depth, r.index)
@@ -429,6 +454,8 @@ class Membership:
                          decode_pages_free=r.decode_pages_free,
                          decode_spec_accept_rate=r.decode_spec_accept_rate,
                          mesh_shape=r.mesh_shape, tp=r.tp, ep=r.ep, pp=r.pp,
+                         kv_dtype=r.kv_dtype,
+                         kv_bytes_per_page=r.kv_bytes_per_page,
                          version=r.version,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
@@ -441,7 +468,8 @@ class Membership:
     def publish_gauges(self) -> None:
         """Export the fleet table as Prometheus gauges:
         ``router/replica<i>/{healthy,ejected,inflight,error_rate,hedges,
-        kv_pages_free,spec_accept_rate,tp,ep,pp,version}``."""
+        kv_pages_free,kv_dtype_code,kv_bytes_per_page,spec_accept_rate,
+        tp,ep,pp,version}``."""
         for row in self.snapshot():
             prefix = f"router/replica{row['index']}"
             total = row["successes"] + row["failures"]
@@ -455,6 +483,15 @@ class Membership:
             self.metrics.gauge(f"{prefix}/hedges", float(row["hedges"]))
             self.metrics.gauge(f"{prefix}/kv_pages_free",
                                float(row["decode_pages_free"]))
+            # quantized-pool capacity: dtype code (0=bf16, 1=int8, 2=fp8;
+            # -1 unknown) and bytes-per-page, so a dashboard can plot
+            # effective byte headroom (pages_free x bytes_per_page) on a
+            # mixed-precision fleet
+            code = (float(quant.KV_DTYPES.index(row["kv_dtype"]))
+                    if row["kv_dtype"] in quant.KV_DTYPES else -1.0)
+            self.metrics.gauge(f"{prefix}/kv_dtype_code", code)
+            self.metrics.gauge(f"{prefix}/kv_bytes_per_page",
+                               float(row["kv_bytes_per_page"]))
             self.metrics.gauge(f"{prefix}/spec_accept_rate",
                                float(row["decode_spec_accept_rate"]))
             # model-parallel degrees: a fleet dashboard reading capacity off

@@ -422,6 +422,12 @@ class InferenceServer:
                 "free_slots": (int(kv.get("num_slots", 0))
                                - int(kv.get("slots_active", 0))),
                 "pages_free": int(kv.get("pages_free", 0)),
+                # quantized-pool layout: replicas with different pool
+                # dtypes report different effective capacity per page, so
+                # routers compare BYTE headroom (pages_free x
+                # kv_bytes_per_page), not raw page counts
+                "kv_dtype": kv.get("kv_dtype", "bf16"),
+                "kv_bytes_per_page": int(kv.get("kv_bytes_per_page") or 0),
                 # model-parallel layout: membership/routers export these as
                 # per-replica gauges, and capacity math (pages_free is
                 # per-REPLICA, not per-device) needs the degree

@@ -29,12 +29,23 @@ into the conv anyway).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 MODES = ("weight_only", "dynamic")
+
+#: pool layouts the paged KV cache can serve ("bf16" is the unquantized
+#: compute-dtype pool; int8/fp8 store quantized rows + per-page-per-head
+#: f32 scales). fp8 is gated on the installed jax/ml_dtypes exposing
+#: float8_e4m3fn — no new dependency, just feature detection.
+KV_DTYPES = ("bf16", "int8", "fp8")
+
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+#: symmetric quantization ceilings: int8 clips at +-127, e4m3 saturates
+#: at +-448 (the format's largest finite value)
+_KV_QMAX = {"int8": 127.0, "fp8": 448.0}
 
 
 def quantize_tensor(w, axis: int = -1):
@@ -174,3 +185,145 @@ def np_size(arr) -> int:
         import numpy as np
 
         return int(np.asarray(arr).size)
+
+
+# ---------------------------------------------------------------------------
+# Quantized paged-KV pool: per-page-per-head symmetric scales
+# ---------------------------------------------------------------------------
+#
+# The serving pool stores K/V rows in int8 or fp8 (e4m3) with ONE f32 scale
+# per (layer, page, head), kept in a tensor alongside the page tables. The
+# scheme is the same symmetric absmax quantization as `quantize_tensor`, at
+# page-head granularity: dequantized row = stored_row * scale[page, head].
+# A scale of 0 marks a page-head nothing nonzero was ever written to — its
+# stored rows are exact zeros, so readers multiply by the raw scale without
+# a guard and still get exact zeros.
+#
+# Appends update the scale as a RUNNING absmax: when a new row raises a
+# page-head's absmax, the page's already-stored rows are rescaled in place
+# (q_new = cast(q_old * old_scale / new_scale)) so every row in a page
+# always shares the page's current scale. A row landing at offset 0 resets
+# the running max — the page is being reused and its prior content (and
+# scale) is stale. Rescaling is exact when the scale did not change
+# (ratio == 1) and touches only the pages being written, never the pool.
+
+
+def kv_quant_supported(kv_dtype: str) -> bool:
+    """True when this install can serve the given pool layout ("fp8"
+    requires jnp.float8_e4m3fn; "bf16"/"int8" always work)."""
+    return kv_dtype in KV_DTYPES and (kv_dtype != "fp8"
+                                      or _FP8_DTYPE is not None)
+
+
+def kv_pool_dtype(kv_dtype: str) -> Tuple[Any, float]:
+    """``"int8" | "fp8" -> (storage dtype, quantization ceiling)``."""
+    if kv_dtype not in ("int8", "fp8"):
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got "
+                         f"{kv_dtype!r} (bf16 pools are not quantized)")
+    if kv_dtype == "fp8":
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "kv_dtype='fp8' needs jax.numpy.float8_e4m3fn, which this "
+                "jax/ml_dtypes install does not expose; use 'int8'")
+        return _FP8_DTYPE, _KV_QMAX["fp8"]
+    return jnp.int8, _KV_QMAX["int8"]
+
+
+def kv_cast(x, dtype, qmax: float):
+    """f32 -> pool storage dtype with symmetric saturation. int8 rounds to
+    nearest; fp8 rounds via the hardware/emulated e4m3 cast."""
+    if dtype == jnp.int8:
+        return jnp.clip(jnp.round(x), -qmax, qmax).astype(jnp.int8)
+    return jnp.clip(x, -qmax, qmax).astype(dtype)
+
+
+def quantize_kv_pages(pages, kv_dtype: str):
+    """Quantize whole pages: ``pages [..., page, H, D]`` float ->
+    ``(q [..., page, H, D], scale [..., H])`` with one symmetric scale per
+    trailing (page, head) block — absmax over the page's rows and head_dim.
+    Empty (all-zero) page-heads get scale 0 (see module note)."""
+    dtype, qmax = kv_pool_dtype(kv_dtype)
+    pf = jnp.asarray(pages, jnp.float32)
+    amax = jnp.max(jnp.abs(pf), axis=(-3, -1))            # [..., H]
+    scale = amax / qmax
+    eff = jnp.where(scale > 0, scale, 1.0)
+    q = kv_cast(pf / eff[..., None, :, None], dtype, qmax)
+    return q, scale
+
+
+def dequantize_kv_pages(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_pages`: ``q [..., page, H, D]`` with
+    ``scale [..., H]`` -> float pages. Safe for scale == 0 (stored rows are
+    exact zeros there)."""
+    return (q.astype(jnp.float32) * scale[..., None, :, None]).astype(dtype)
+
+
+def paged_quant_write_pages(q_pool, scales, layer, page_ids, pages):
+    """Commit whole freshly-computed pages into the quantized pool (the
+    prefill ladder's write): quantize each page with its own per-head scale
+    and overwrite both the rows and the scale entries.
+
+    ``q_pool [L, pages, page, H, D]`` int8/fp8; ``scales [L, pages, H]``
+    f32; ``page_ids [N]`` int32; ``pages [N, page, H, D]`` float."""
+    qmax = _KV_QMAX["int8"] if q_pool.dtype == jnp.int8 else _KV_QMAX["fp8"]
+    pf = jnp.asarray(pages, jnp.float32)
+    amax = jnp.max(jnp.abs(pf), axis=(1, 3))              # [N, H]
+    scale = amax / qmax
+    eff = jnp.where(scale > 0, scale, 1.0)
+    q = kv_cast(pf / eff[:, None, :, None], q_pool.dtype, qmax)
+    q_pool = q_pool.at[layer, page_ids].set(q)
+    scales = scales.at[layer, page_ids].set(scale)
+    return q_pool, scales
+
+
+def paged_quant_append(q_pool, scales, layer, page_ids, offs, rows):
+    """Append rows into the quantized pool at ``(layer, page_ids, offs)``,
+    maintaining the per-page-per-head running scale.
+
+    ``rows [..., H, D]`` float with matching ``page_ids``/``offs [...]``
+    int32 (any batch shape — decode lanes, suffix-chunk tokens, or the
+    verify grid's [B, S]). Steps, all on the touched pages only:
+
+    1. scatter-max the new rows' absmax into the scale plane (a row at
+       offset 0 first RESETS its page's running max — page reuse);
+    2. rescale the touched pages' stored rows from the old scale to the
+       new one (exact no-op when the scale did not grow);
+    3. quantize the new rows with the final scale and scatter them in.
+
+    Duplicate page targets (several rows landing in one page, or masked
+    rows aimed at scratch page 0) are sound: the scatter-max folds their
+    maxima, and the page-rescale scatter writes identical values."""
+    qmax = _KV_QMAX["int8"] if q_pool.dtype == jnp.int8 else _KV_QMAX["fp8"]
+    num_pages = q_pool.shape[1]
+    h, d = q_pool.shape[-2], q_pool.shape[-1]
+    pids = jnp.reshape(page_ids, (-1,))
+    offv = jnp.reshape(jnp.broadcast_to(offs, jnp.shape(page_ids)), (-1,))
+    rowsf = jnp.reshape(jnp.asarray(rows, jnp.float32), (-1, h, d))
+    rmax = jnp.max(jnp.abs(rowsf), axis=-1)               # [N, H]
+
+    plane = scales[layer]                                 # [pages, H]
+    fresh = jnp.zeros((num_pages, 1), jnp.float32).at[pids].max(
+        (offv == 0).astype(jnp.float32)[:, None])
+    old_plane = plane * (1.0 - fresh)
+    new_plane = old_plane.at[pids].max(rmax / qmax)
+
+    eff = new_plane[pids]                                 # [N, H]
+    eff = jnp.where(eff > 0, eff, 1.0)
+    rows_q = kv_cast(rowsf / eff[:, :, None], q_pool.dtype, qmax)
+    ratio = old_plane[pids] / eff                         # <= 1; 0 when fresh
+    pages_q = q_pool[layer, pids]                         # [N, page, H, D]
+    pages_r = kv_cast(pages_q.astype(jnp.float32) * ratio[:, None, :, None],
+                      q_pool.dtype, qmax)
+    q_pool = q_pool.at[layer, pids].set(pages_r)
+    q_pool = q_pool.at[layer, pids, offv].set(rows_q)
+    scales = scales.at[layer].set(new_plane)
+    return q_pool, scales
+
+
+def paged_quant_gather(q_pool, scales, layer, page_ids, dtype=jnp.float32):
+    """Gather-and-dequantize pages ``page_ids`` of one layer — the
+    suffix-prefill attend's manual gather. The convert runs on the GATHERED
+    rows, never the whole pool (the defect GC-J108 exists to catch)."""
+    g = q_pool[layer, page_ids].astype(jnp.float32)       # [..., page, H, D]
+    s = scales[layer, page_ids]                           # [..., H]
+    return (g * s[..., None, :, None]).astype(dtype)

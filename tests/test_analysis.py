@@ -110,6 +110,61 @@ def test_lint_train_step_runs_on_preset():
     assert lint_train_step(mlp, "x:0", "y:0", "adam", batch=4) == []
 
 
+def test_j108_full_pool_dequant_both_directions():
+    """GC-J108 fires on a step that widens the ENTIRE quantized KV pool to
+    float before gathering pages, stays quiet when the convert runs on the
+    gathered pages only (the dequant-on-read idiom), and honors ignore."""
+    from sparkflow_tpu.analysis.jaxpr_lint import lint_decode_collectives
+
+    NUM_PAGES, page, h, d = 33, 8, 4, 8
+    pool = jax.ShapeDtypeStruct((2, NUM_PAGES, page, h, d), jnp.int8)
+    scales = jax.ShapeDtypeStruct((2, NUM_PAGES, h), np.float32)
+    table = jax.ShapeDtypeStruct((4, 2), np.int32)
+
+    def bad_step(kp, sc, t):
+        # the planted defect: dequantize the whole pool, then gather
+        full = kp.astype(jnp.float32) * sc[:, :, None, :, None]
+        return full[0][t]
+
+    found = lint_decode_collectives(bad_step, (pool, scales, table),
+                                    kv_pool_pages=NUM_PAGES)
+    assert any(f.rule == "GC-J108" for f in found), found
+    f = next(f for f in found if f.rule == "GC-J108")
+    assert f.detail["kv_pool_pages"] == NUM_PAGES
+
+    def good_step(kp, sc, t):
+        # dequant-on-read: convert only the gathered pages
+        g = kp[0][t].astype(jnp.float32)
+        return g * sc[0][t][:, :, None, :, None]
+
+    assert lint_decode_collectives(good_step, (pool, scales, table),
+                                   kv_pool_pages=NUM_PAGES) == []
+    # without a quantized pool declared, the scan is off entirely
+    assert lint_decode_collectives(bad_step, (pool, scales, table)) == []
+    # and the ignore escape hatch silences it
+    assert lint_decode_collectives(bad_step, (pool, scales, table),
+                                   kv_pool_pages=NUM_PAGES,
+                                   ignore=("GC-J108",)) == []
+
+
+def test_j108_quantized_engine_repo_clean():
+    """The repo's own int8 decode step never materializes the float pool:
+    lint_decode_step wires kv_pool_pages automatically for a quantized
+    engine and must come back empty."""
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json as _mfj)
+    from sparkflow_tpu.serving import DecodeEngine
+    from sparkflow_tpu.analysis.jaxpr_lint import lint_decode_step
+
+    spec = build_registry_spec("transformer_lm", vocab_size=61, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=32, dropout=0.0)
+    m = _mfj(spec)
+    eng = DecodeEngine(m, m.init(jax.random.PRNGKey(0)), num_slots=4,
+                       page_size=8, seed=0, kv_quant="int8", warmup=False)
+    assert lint_decode_step(eng) == []
+
+
 # ---------------------------------------------------------------------------
 # ast_lint: planted defects (GC-A2xx)
 # ---------------------------------------------------------------------------

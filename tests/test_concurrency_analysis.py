@@ -325,6 +325,31 @@ def test_r402_unguarded_counter_reported_with_stacks():
         tracker.assert_clean()
 
 
+def test_r402_sequential_nonoverlapping_threads_still_report():
+    # the OS reuses thread idents: a worker that fully finishes before its
+    # sibling starts can hand the sibling the SAME get_ident() value, which
+    # used to alias both into one "thread" and silently miss the race (the
+    # exact interleaving a loaded 1-core run produces). The tracker now
+    # assigns its own per-thread serials, so two non-overlapping threads
+    # touching an unguarded field must still report.
+    with racecheck.RaceTracker() as tracker:
+        c = _Counter()
+        racecheck.instrument_object(c, fields=("n",))
+
+        def bump():
+            for _ in range(50):
+                c.n += 1
+
+        for _ in range(2):          # start/join one at a time: zero overlap
+            t = threading.Thread(target=bump)
+            t.start()
+            t.join()
+    fs = tracker.findings()
+    assert len(fs) == 1 and fs[0].rule == "GC-R402", \
+        [f.render() for f in fs]
+    assert len(fs[0].detail["threads"]) >= 2
+
+
 def test_r402_guarded_counter_clean():
     with racecheck.RaceTracker() as tracker:
         c = _Counter()

@@ -1,0 +1,554 @@
+"""Quantized KV cache (int8/fp8) with dequant-on-read paged attention.
+
+Covers the PR's acceptance criteria directly: pallas kernel parity with the
+jnp reference on quantized pools across page sizes (including empty slots
+and garbage-page isolation), the running-scale append/write semantics in
+``utils.quant``, pool-neutral churn on a quantized ``PagedKVCache``,
+greedy token parity of int8/fp8 engines against the dense forward — alone
+and composed with speculation + prefix cache + chunked prefill + a 2D
+pp x tp mesh — and the up-front ctor validation battery.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkflow_tpu.models.registry import build_registry_spec, model_from_json
+from sparkflow_tpu.ops import (paged_attention, paged_attention_reference,
+                               paged_attention_verify,
+                               paged_attention_verify_reference)
+from sparkflow_tpu.ops.attention import last_attention_path
+from sparkflow_tpu.parallel.mesh import make_mesh
+from sparkflow_tpu.serving import DecodeEngine, PagedKVCache
+from sparkflow_tpu.sharding import ShardingConfig
+from sparkflow_tpu.utils import quant
+
+QDTYPES = ["int8", "fp8"]
+
+#: |quantized attention - full-precision attention| ceiling per dtype.
+#: int8 carries ~0.4% relative rounding per element; e4m3 ~3%. After the
+#: softmax contraction the observed max error is ~5x smaller than these.
+ATT_TOL = {"int8": 0.05, "fp8": 0.25}
+
+
+def _need(kv_dtype):
+    if not quant.kv_quant_supported(kv_dtype):
+        pytest.skip(f"{kv_dtype} KV pools unsupported by this jax install")
+
+
+def _rand_paged(rs, b, h, d, page_size, max_pages, lengths):
+    """Random q + float pools + a valid page table (page 0 is scratch)."""
+    num_pages = 1 + b * max_pages
+    q = rs.randn(b, h, d).astype(np.float32)
+    k = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    v = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    table = np.zeros((b, max_pages), np.int32)
+    nxt = 1
+    for i, ln in enumerate(lengths):
+        for p in range((ln + page_size - 1) // page_size):
+            table[i, p] = nxt
+            nxt += 1
+    return q, k, v, table, np.asarray(lengths, np.int32)
+
+
+def _quant_pools(k, v, kv_dtype):
+    qk, ks = quant.quantize_kv_pages(k, kv_dtype)
+    qv, vs = quant.quantize_kv_pages(v, kv_dtype)
+    return qk, ks, qv, vs
+
+
+# -- dequant-on-read kernel parity --------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", QDTYPES)
+@pytest.mark.parametrize("page_size", [8, 16, 64])
+def test_paged_attention_quant_parity(page_size, kv_dtype):
+    """The quantized pallas decode kernel == the quantized jnp reference on
+    the same int8/fp8 pool (near-exact — both dequantize in f32), and both
+    stay within the dtype's error envelope of the full-precision answer.
+    Ragged lengths include an empty slot, which must come out exact zeros."""
+    _need(kv_dtype)
+    rs = np.random.RandomState(page_size)
+    b, h, d, max_pages = 4, 4, 16, 3
+    lengths = [0, 1, page_size + 3, max_pages * page_size]
+    q, k, v, table, lens = _rand_paged(rs, b, h, d, page_size, max_pages,
+                                       lengths)
+    qk, ks, qv, vs = _quant_pools(k, v, kv_dtype)
+    ref = paged_attention_reference(q, qk, qv, table, lens,
+                                    k_scales=ks, v_scales=vs)
+    out = paged_attention(q, qk, qv, table, lens, interpret=True,
+                          k_scales=ks, v_scales=vs)
+    assert last_attention_path() == "pallas"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert np.all(np.asarray(out)[0] == 0.0)  # empty slot: zeros, not NaN
+    assert np.isfinite(np.asarray(out)).all()
+    full = np.asarray(paged_attention_reference(q, k, v, table, lens))
+    err = np.max(np.abs(np.asarray(out) - full))
+    assert err < ATT_TOL[kv_dtype], (kv_dtype, err)
+
+
+def _rand_paged_verify(rs, b, h, s, d, page_size, max_pages, starts):
+    num_pages = 1 + b * max_pages
+    q = rs.randn(b, h, s, d).astype(np.float32)
+    k = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    v = rs.randn(num_pages, page_size, h, d).astype(np.float32)
+    table = np.zeros((b, max_pages), np.int32)
+    nxt = 1
+    for i, st in enumerate(starts):
+        for p in range((st + s + page_size - 1) // page_size):
+            table[i, p] = nxt
+            nxt += 1
+    return q, k, v, table, np.asarray(starts, np.int32)
+
+
+@pytest.mark.parametrize("kv_dtype", QDTYPES)
+@pytest.mark.parametrize("page_size", [8, 16, 64])
+def test_paged_verify_quant_parity(page_size, kv_dtype):
+    """The quantized multi-query verify kernel == its quantized reference
+    across ragged chunk starts (including start 0: no committed history),
+    and within the dtype envelope of the full-precision verify."""
+    _need(kv_dtype)
+    rs = np.random.RandomState(page_size)
+    b, h, s, d, max_pages = 4, 4, 4, 16, 4
+    starts = [0, 1, page_size - 1, 2 * page_size + 3]
+    q, k, v, table, st = _rand_paged_verify(rs, b, h, s, d, page_size,
+                                            max_pages, starts)
+    qk, ks, qv, vs = _quant_pools(k, v, kv_dtype)
+    ref = paged_attention_verify_reference(q, qk, qv, table, st,
+                                           k_scales=ks, v_scales=vs)
+    out = paged_attention_verify(q, qk, qv, table, st, interpret=True,
+                                 k_scales=ks, v_scales=vs)
+    assert last_attention_path() == "pallas"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    assert np.isfinite(np.asarray(out)).all()
+    full = np.asarray(paged_attention_verify_reference(q, k, v, table, st))
+    err = np.max(np.abs(np.asarray(out) - full))
+    assert err < ATT_TOL[kv_dtype], (kv_dtype, err)
+
+
+@pytest.mark.parametrize("kv_dtype", QDTYPES)
+def test_paged_attention_quant_garbage_isolation(kv_dtype):
+    """Stored rows past a slot's length AND whole pages outside every
+    table (stale pool content, poisoned scales included) must not leak
+    into any output — the masks run before the dequant contributes."""
+    _need(kv_dtype)
+    rs = np.random.RandomState(3)
+    q, k, v, table, lens = _rand_paged(rs, 1, 2, 8, 8, 2, [9])
+    qk, ks, qv, vs = _quant_pools(k, v, kv_dtype)
+    out1 = np.asarray(paged_attention(q, qk, qv, table, lens,
+                                      interpret=True, k_scales=ks,
+                                      v_scales=vs))
+    qk2, qv2 = np.asarray(qk).copy(), np.asarray(qv).copy()
+    ks2, vs2 = np.asarray(ks).copy(), np.asarray(vs).copy()
+    # beyond token 9 inside the referenced second page (scale untouched:
+    # rescaling the page would legitimately change the live rows)
+    qk2[table[0, 1], 2:] = qk2.dtype.type(60)
+    qv2[table[0, 1], 2:] = qv2.dtype.type(-60)
+    # every page no table references, rows and scales both poisoned
+    used = set(table.flatten().tolist())
+    for p in range(qk2.shape[0]):
+        if p not in used:
+            qk2[p] = qk2.dtype.type(77)
+            qv2[p] = qv2.dtype.type(-77)
+            ks2[p] = 1e6
+            vs2[p] = 1e6
+    out2 = np.asarray(paged_attention(q, qk2, qv2, table, lens,
+                                      interpret=True, k_scales=ks2,
+                                      v_scales=vs2))
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+    # and the verify kernel under the same poisoning
+    qv_q = rs.randn(1, 2, 3, 8).astype(np.float32)
+    st = np.asarray([6], np.int32)
+    o1 = np.asarray(paged_attention_verify(qv_q, qk, qv, table, st,
+                                           interpret=True, k_scales=ks,
+                                           v_scales=vs))
+    o2 = np.asarray(paged_attention_verify(qv_q, qk2, qv2, table, st,
+                                           interpret=True, k_scales=ks2,
+                                           v_scales=vs2))
+    np.testing.assert_allclose(o1, o2, atol=1e-6)
+
+
+# -- quantization primitives (utils.quant) ------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", QDTYPES)
+def test_quantize_roundtrip_bound_and_empty_pages(kv_dtype):
+    """quantize -> dequantize stays inside the symmetric-quantization error
+    bound per (page, head) block; all-zero pages round-trip exactly with
+    scale 0 (the empty-page convention)."""
+    _need(kv_dtype)
+    rs = np.random.RandomState(0)
+    pages = rs.randn(5, 8, 4, 16).astype(np.float32) * 3.0
+    pages[2] = 0.0                                    # an empty page
+    q, s = quant.quantize_kv_pages(pages, kv_dtype)
+    deq = np.asarray(quant.dequantize_kv_pages(q, s))
+    s = np.asarray(s)
+    assert s.shape == (5, 4)
+    assert (s[2] == 0.0).all() and (deq[2] == 0.0).all()
+    # int8: half-step absolute bound per block; e4m3: ~2^-3 relative
+    amax = np.abs(pages).max(axis=(1, 3))             # [pages, H]
+    bound = (s * 0.5 + 1e-6 if kv_dtype == "int8"
+             else amax * 2.0 ** -3 + 1e-6)
+    err = np.abs(deq - pages).max(axis=(1, 3))
+    assert (err <= bound).all(), (err, bound)
+
+
+@pytest.mark.parametrize("kv_dtype", QDTYPES)
+def test_paged_quant_append_running_scale(kv_dtype):
+    """The append path maintains a per-page running absmax: growing rows
+    rescale the page's stored history in place (old rows still dequantize
+    to their values), and a row landing at offset 0 RESETS the page's
+    scale — stale content from the page's previous tenant never poisons
+    the new sequence's precision."""
+    _need(kv_dtype)
+    store, _ = quant.kv_pool_dtype(kv_dtype)
+    L, P, page, h, d = 1, 3, 4, 2, 4
+    pool = jnp.zeros((L, P, page, h, d), store)
+    scales = jnp.zeros((L, P, h), jnp.float32)
+    rs = np.random.RandomState(1)
+    r0 = rs.randn(1, h, d).astype(np.float32) * 0.1   # small opener
+    r1 = rs.randn(1, h, d).astype(np.float32) * 0.1
+    big = rs.randn(1, h, d).astype(np.float32) * 8.0  # scale-growing row
+    pid = jnp.asarray([1], jnp.int32)
+    pool, scales = quant.paged_quant_append(pool, scales, 0, pid,
+                                            jnp.asarray([0], jnp.int32), r0)
+    pool, scales = quant.paged_quant_append(pool, scales, 0, pid,
+                                            jnp.asarray([1], jnp.int32), r1)
+    small_scale = float(np.asarray(scales)[0, 1].max())
+    pool, scales = quant.paged_quant_append(pool, scales, 0, pid,
+                                            jnp.asarray([2], jnp.int32), big)
+    grown = float(np.asarray(scales)[0, 1].max())
+    assert grown > small_scale * 4                    # the max really grew
+    def atol(vals, scale):
+        # int8: half a quantization step (+ rescale slop); e4m3: relative
+        # ulp of the stored magnitude
+        if kv_dtype == "int8":
+            return scale * 0.5 + 0.02
+        return float(np.abs(vals).max()) * 0.07 + 0.02
+
+    deq = np.asarray(quant.dequantize_kv_pages(pool[0, 1], scales[0, 1]))
+    np.testing.assert_allclose(deq[0], r0[0], atol=atol(r0, grown))
+    np.testing.assert_allclose(deq[1], r1[0], atol=atol(r1, grown))
+    np.testing.assert_allclose(deq[2], big[0], atol=atol(big, grown))
+    # page reuse: offset 0 resets the running max to the new tenant's
+    pool, scales = quant.paged_quant_append(pool, scales, 0, pid,
+                                            jnp.asarray([0], jnp.int32), r1)
+    reset = float(np.asarray(scales)[0, 1].max())
+    assert reset < grown / 4, (reset, grown)
+    deq = np.asarray(quant.dequantize_kv_pages(pool[0, 1], scales[0, 1]))
+    np.testing.assert_allclose(deq[0], r1[0], atol=atol(r1, reset))
+    # untouched pages never moved
+    assert (np.asarray(scales)[0, [0, 2]] == 0.0).all()
+    assert (np.asarray(pool)[0, [0, 2]].astype(np.float32) == 0.0).all()
+
+
+def test_paged_quant_write_pages_matches_quantize():
+    """The prefill ladder's whole-page commit is exactly the block
+    quantizer applied per page, rows and scale entries both."""
+    rs = np.random.RandomState(2)
+    fresh = rs.randn(2, 4, 2, 4).astype(np.float32)
+    pool = jnp.zeros((1, 5, 4, 2, 4), jnp.int8)
+    scales = jnp.zeros((1, 5, 2), jnp.float32)
+    pids = jnp.asarray([1, 3], jnp.int32)
+    pool, scales = quant.paged_quant_write_pages(pool, scales, 0, pids,
+                                                 fresh)
+    q_ref, s_ref = quant.quantize_kv_pages(fresh, "int8")
+    np.testing.assert_array_equal(np.asarray(pool)[0, [1, 3]],
+                                  np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(scales)[0, [1, 3]],
+                               np.asarray(s_ref))
+    assert (np.asarray(pool)[0, [0, 2, 4]] == 0).all()
+
+
+def test_kv_pool_dtype_validation(monkeypatch):
+    with pytest.raises(ValueError, match="not quantized"):
+        quant.kv_pool_dtype("bf16")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        quant.kv_pool_dtype("int4")
+    monkeypatch.setattr(quant, "_FP8_DTYPE", None)
+    assert not quant.kv_quant_supported("fp8")
+    with pytest.raises(ValueError, match="float8_e4m3fn"):
+        quant.kv_pool_dtype("fp8")
+
+
+# -- quantized page pool: byte accounting + churn neutrality ------------------
+
+
+def test_kvcache_quantized_stats_and_validation():
+    kv = PagedKVCache(num_pages=9, page_size=8, num_slots=2,
+                      max_pages_per_slot=4, kv_dtype="int8",
+                      kv_bytes_per_page=1088)
+    st = kv.stats()
+    assert st["kv_dtype"] == "int8" and st["kv_bytes_per_page"] == 1088
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVCache(num_pages=9, page_size=8, num_slots=2,
+                     max_pages_per_slot=4, kv_dtype="int4")
+
+
+def test_kvcache_quantized_no_leak_under_spec_churn():
+    """200 iterations of speculative append-k / accept-a / truncate churn
+    with prefix sharing on an int8-layout pool: the manager is byte-layout
+    agnostic, so refcount conservation and full drain must hold exactly as
+    they do for bf16 — quantization changes page CONTENT, never page
+    accounting."""
+    kv = PagedKVCache(num_pages=33, page_size=4, num_slots=4,
+                      max_pages_per_slot=8, kv_dtype="int8",
+                      kv_bytes_per_page=144)
+    rs = np.random.RandomState(4)
+    prefixes = [list(rs.randint(1, 50, size=8)) for _ in range(2)]
+    live = {}
+    for _ in range(200):
+        slot = kv.free_slot()
+        if slot is not None and rs.rand() < 0.5:
+            pref = prefixes[rs.randint(len(prefixes))]
+            prompt = pref + [int(x) for x in
+                             rs.randint(1, 50, size=rs.randint(1, 5))]
+            total = len(prompt) + int(rs.randint(4, 12))
+            if kv.can_admit(total, prompt):
+                kv.alloc(slot, prompt, total)
+                kv.commit_prefix(slot, prompt)
+                live[slot] = total
+        for s in list(live):
+            ln, total = kv.length(s), live[s]
+            room = total - ln
+            if room <= 0 or rs.rand() < 0.2:
+                kv.free(s)
+                del live[s]
+                continue
+            k = int(min(room, 1 + rs.randint(4)))      # speculative window
+            kv.append(s, k)
+            a = int(rs.randint(1, k + 1))              # accepted prefix
+            kv.truncate(s, ln + a)
+        rc = kv.refcounts()
+        assert (rc >= 0).all()
+        tables = kv.page_tables()
+        held = int(np.count_nonzero(tables[sorted(live)])) if live else 0
+        assert int(rc.sum()) == held, "refcount conservation broken"
+    for s in list(live):
+        kv.free(s)
+    st = kv.stats()
+    assert st["pages_used"] == 0 and st["pages_reserved"] == 0
+    assert st["pages_free"] == 32 and st["tokens"] == 0
+    assert (kv.refcounts() == 0).all()
+    assert st["kv_dtype"] == "int8"
+
+
+# -- quantized decode engine --------------------------------------------------
+
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = build_registry_spec("transformer_lm", vocab_size=VOCAB, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=32, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def engine_q8(lm):
+    """One int8 engine for the section with speculation AND chunked prefill
+    on — every decode feature rides the quantized pool."""
+    model, params = lm
+    yield DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, spec_k=3, kv_quant="int8")
+
+
+def _dense_greedy(model, params, prompt, n):
+    ids = list(prompt)
+    out = []
+    for _ in range(n):
+        x = np.asarray(ids, np.int32)[None, :]
+        logits = model.apply(params, {"input_ids": x}, ["logits"])["logits"]
+        nxt = int(np.argmax(np.asarray(logits[0, -1])))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def _engine_greedy(eng, prompt, n):
+    info = eng.prefill(prompt, max_new_tokens=n, temperature=0.0)
+    toks = [] if info["token"] is None else [info["token"]]
+    while len(toks) < n:
+        out = eng.step()
+        if info["slot"] in out:
+            toks.extend(out[info["slot"]])
+    eng.release(info["slot"])
+    return toks[:n], info
+
+
+@pytest.mark.slow  # ~38s: full greedy battery on the shared engine; run by
+# path (make kvquant-smoke) when touching the quantized decode plane
+def test_quant_engine_greedy_parity_battery(engine_q8, lm):
+    """int8 KV greedy decode is token-identical to the dense forward across
+    a plain prompt, a prefix-publishing prompt, a chunked-admission prompt,
+    and a prefix-COW replay — speculation on throughout, zero steady-state
+    retraces. The quantization error moves logits by ~1e-4 here, far below
+    any greedy argmax margin, so the text must not move at all."""
+    model, params = lm
+    eng = engine_q8
+    sysp = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13, 12, 10]
+    prompts = [[5, 2, 8],            # plain short
+               sysp + [17, 18],      # publishes the shared prefix blocks
+               list(range(1, 25))]   # 24 tokens: chunked admission
+    for p in prompts:
+        toks, _ = _engine_greedy(eng, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6), f"diverged on {p}"
+    # replay: COW prefix hit on the QUANTIZED pool + speculation — the
+    # shared pages are reused as stored int8 rows + scales, byte-identical
+    toks, info = _engine_greedy(eng, sysp + [17, 18], 6)
+    assert info["shared_tokens"] == 8
+    assert toks == _dense_greedy(model, params, sysp + [17, 18], 6)
+    st = eng.stats()
+    assert st["steady_traces"] == 0, (
+        f"quantized decode retraced after warmup: {st}")
+    assert st["spec"]["steps"] > 0
+    assert eng.kv.stats()["prefix_hits"] >= 1
+
+
+def test_quant_engine_stats_bytes_and_error_probe(engine_q8, lm):
+    """The engine self-reports its pool layout: kv_quant in stats, byte
+    accounting showing >= 1.9x pages-per-byte vs the float pool, and the
+    warmup error probe pinned a finite, small max-logit delta vs bf16."""
+    model, _ = lm
+    st = engine_q8.stats()
+    assert st["kv_quant"] == "int8"
+    kv = st["kv"]
+    assert kv["kv_dtype"] == "int8"
+    cdt = model.compute_dtype if model.compute_dtype is not None \
+        else jnp.float32
+    float_bpp = (2 * int(model.num_layers) * engine_q8.page_size
+                 * int(model.num_heads) * int(model.head_dim)
+                 * np.dtype(cdt).itemsize)
+    assert float_bpp >= 1.9 * kv["kv_bytes_per_page"], (
+        "int8 pool must fit >= 1.9x the pages per byte: "
+        f"{float_bpp} vs {kv['kv_bytes_per_page']}")
+    err = st["kv_quant_error"]
+    assert err is not None and np.isfinite(err) and 0.0 <= err < 0.05
+    assert engine_q8.metrics.summary()["gauges"]["decode/kv_quant_error"] \
+        == err
+
+
+def test_quant_engine_pool_neutral_accept_reject(engine_q8):
+    """Speculative accept/reject churn on the quantized pool drains
+    page-neutral: after releasing every request the pool is back to its
+    baseline free count (rollback truncates return quantized pages to the
+    allocator unchanged)."""
+    eng = engine_q8
+    base = eng.kv.stats()
+    assert base["pages_used"] == 0
+    rs = np.random.RandomState(7)
+    for _ in range(6):
+        prompts = [[int(x) for x in rs.randint(1, VOCAB, size=rs.randint(
+            1, 9))] for _ in range(3)]
+        infos = [eng.prefill(p, max_new_tokens=16, temperature=0.0)
+                 for p in prompts]
+        for _ in range(3):                 # spec bursts: up to k+1 per step
+            eng.step()
+        for i in infos:
+            eng.release(i["slot"])
+    st = eng.kv.stats()
+    assert st["pages_used"] == 0 and st["pages_reserved"] == 0
+    assert st["pages_free"] == base["pages_free"]
+    assert st["slots_active"] == 0
+    assert eng.stats()["steady_traces"] == 0
+
+
+@pytest.mark.slow  # ~14s: second engine build; run by path (kvquant-smoke)
+def test_fp8_engine_greedy_parity(lm):
+    """An fp8 pool serves greedy text identical to the dense forward on
+    short prompts (e4m3's ~3% relative error still clears this model's
+    argmax margins) with zero steady retraces."""
+    _need("fp8")
+    model, params = lm
+    eng = DecodeEngine(model, params, num_slots=2, page_size=8, seed=0,
+                       kv_quant="fp8")
+    for p in ([5, 2, 8], [4, 4]):
+        toks, _ = _engine_greedy(eng, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6), f"diverged on {p}"
+    st = eng.stats()
+    assert st["kv_quant"] == "fp8" and st["steady_traces"] == 0
+    assert st["kv"]["kv_dtype"] == "fp8"
+
+
+@pytest.mark.slow  # ~22s: pp2xtp2 mesh engine build; run by path
+# (make kvquant-smoke) when touching the quantized decode plane
+def test_quant_composition_pp_tp_spec_prefix_chunked_parity(lm):
+    """The full stack at once: int8 pool + speculation + prefix cache +
+    chunked prefill on a 2D pp x tp mesh. Rows shard on heads (tp) and
+    layers (pp); scales shard on heads and layers with no page axis —
+    greedy output stays token-identical to the dense forward, zero steady
+    retraces, and the pool reports its quantized layout."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (conftest forces 8 on CPU)")
+    model, params = lm
+    mesh2d = make_mesh({"pp": 2, "tp": 2}, devices=jax.devices()[:4])
+    eng = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0,
+                       prefill_chunk=8, spec_k=3, kv_quant="int8",
+                       mesh=mesh2d,
+                       sharding=ShardingConfig(pp_axis="pp", tp_axis="tp"))
+    sysp = [11, 3, 5, 8, 2, 9, 4, 6, 1, 13, 12, 10]
+    for p in ([5, 2, 8], sysp + [17, 18], list(range(1, 25))):
+        toks, _ = _engine_greedy(eng, p, 6)
+        assert toks == _dense_greedy(model, params, p, 6), f"diverged on {p}"
+    toks, info = _engine_greedy(eng, sysp + [17, 18], 6)
+    assert info["shared_tokens"] == 8
+    assert toks == _dense_greedy(model, params, sysp + [17, 18], 6)
+    st = eng.stats()
+    assert st["steady_traces"] == 0
+    assert st["spec"]["steps"] > 0
+    assert st["kv_quant"] == "int8"
+    par = st["parallel"]
+    assert par["pp"] == 2 and par["tp"] == 2
+
+
+def test_quant_ctor_validation(lm, monkeypatch):
+    """Misconfigurations surface at construction, before any compile."""
+    model, params = lm
+    with pytest.raises(ValueError, match="kv_quant"):
+        DecodeEngine(model, params, num_slots=2, page_size=8,
+                     kv_quant="int4", warmup=False)
+    monkeypatch.setattr(quant, "_FP8_DTYPE", None)
+    with pytest.raises(ValueError, match="float8_e4m3fn"):
+        DecodeEngine(model, params, num_slots=2, page_size=8,
+                     kv_quant="fp8", warmup=False)
+
+
+def test_dense_cache_quant_parity(lm):
+    """The non-paged decode cache also quantizes: init_decode_cache with a
+    kv_dtype carries int8/fp8 rows + per-row scales, and token-by-token
+    decode stays greedy-identical to the float cache."""
+    model, params = lm
+    prompt = [3, 9, 4, 1, 7]
+    refs = _dense_greedy(model, params, prompt, 4)
+    for kv_dtype in QDTYPES:
+        if not quant.kv_quant_supported(kv_dtype):
+            continue
+        cache = model.init_decode_cache(1, max_len=16, kv_dtype=kv_dtype)
+        assert "k_scale" in cache and "v_scale" in cache
+        store, _ = quant.kv_pool_dtype(kv_dtype)
+        assert cache["k"].dtype == store
+        ids = list(prompt)
+        logits = None
+        for pos in range(len(prompt)):
+            tok = jnp.asarray([ids[pos]], jnp.int32)
+            logits, cache = model.decode_step(
+                params, cache, tok, jnp.asarray([pos], jnp.int32))
+        out = []
+        for j in range(4):
+            nxt = int(np.argmax(np.asarray(logits[0])))
+            out.append(nxt)
+            ids.append(nxt)
+            tok = jnp.asarray([nxt], jnp.int32)
+            logits, cache = model.decode_step(
+                params, cache, tok,
+                jnp.asarray([len(ids) - 1], jnp.int32))
+        assert out == refs, f"{kv_dtype} dense cache diverged"

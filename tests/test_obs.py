@@ -367,6 +367,74 @@ def test_fleet_model_parallel_gauges_prometheus_exposition():
         mem.stop()
 
 
+def test_kv_quant_gauges_prometheus_exposition():
+    """The quantized-KV observability surface lands in the Prometheus text
+    end to end: the pool's dtype/byte-layout gauges, the engine's warmup
+    error probe (``decode_kv_quant_error``), and the router's per-replica
+    harvest of each /healthz decode block's kv_dtype + kv_bytes_per_page —
+    a mixed int8/bf16 fleet is visible from the exposition alone."""
+    import jax
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    from sparkflow_tpu.serving.decode import DecodeEngine
+    from sparkflow_tpu.serving.membership import Membership
+    from sparkflow_tpu.utils import quant
+
+    spec = build_registry_spec("transformer_lm", vocab_size=17, hidden=8,
+                               num_layers=2, num_heads=2, mlp_dim=16,
+                               max_len=16, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    m = Metrics()
+    eng = DecodeEngine(model, params, num_slots=2, page_size=4, seed=0,
+                       kv_quant="int8", metrics=m)
+    text = prometheus_text(m)
+    for fam in ("serving_kv_dtype_code", "serving_kv_bytes_per_page",
+                "decode_kv_quant_error"):
+        assert f"# TYPE {fam} gauge" in text, fam
+    code = quant.KV_DTYPES.index("int8")
+    assert f"serving_kv_dtype_code {float(code)}" in text
+    bpp = re.search(r"^serving_kv_bytes_per_page ([0-9.e+-]+)$", text,
+                    re.MULTILINE)
+    assert bpp is not None
+    assert float(bpp.group(1)) == eng.stats()["kv"]["kv_bytes_per_page"]
+    merr = re.search(r"^decode_kv_quant_error ([0-9.e+-]+)$", text,
+                     re.MULTILINE)
+    assert merr is not None
+    assert float(merr.group(1)) == eng.stats()["kv_quant_error"]
+
+    # fleet side: the router harvests each replica's pool layout
+    m2 = Metrics()
+    mem = Membership(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                     metrics=m2)
+    bodies = [
+        {"status": "ok", "queue_depth": 0, "in_flight": 0,
+         "decode": {"free_slots": 2, "pages_free": 16, "kv_dtype": "int8",
+                    "kv_bytes_per_page": 272}},
+        {"status": "ok", "queue_depth": 0, "in_flight": 0,
+         "decode": {"free_slots": 2, "pages_free": 16}},  # bf16 replica
+    ]
+    for replica, body in zip(mem.replicas, bodies):
+        replica.probe_client.healthz = lambda body=body, **kw: body
+    mem.probe_all()
+    try:
+        rows = mem.snapshot()
+        assert rows[0]["kv_dtype"] == "int8"
+        assert rows[0]["kv_bytes_per_page"] == 272
+        assert rows[1]["kv_dtype"] == "bf16"
+        text2 = prometheus_text(m2)
+        for fam in ("router_replica0_kv_dtype_code",
+                    "router_replica0_kv_bytes_per_page",
+                    "router_replica1_kv_dtype_code"):
+            assert f"# TYPE {fam} gauge" in text2, fam
+        assert f"router_replica0_kv_dtype_code {float(code)}" in text2
+        assert "router_replica0_kv_bytes_per_page 272.0" in text2
+        assert ("router_replica1_kv_dtype_code "
+                f"{float(quant.KV_DTYPES.index('bf16'))}") in text2
+    finally:
+        mem.stop()
+
+
 def test_live_weight_version_gauges_prometheus_exposition():
     """The live-weight rollout is observable end to end: each replica's
     harvested serving_version lands as ``router_replica<i>_version`` and the
