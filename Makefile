@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -164,6 +164,14 @@ kvquant-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_kvquant.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/kvquant_smoke.py
 	JAX_PLATFORMS=cpu python bench.py --kv-quant
+
+# fleet-simulator smoke: the sim + policy-parity test suites, then the
+# 1000-replica x 1M-request what-if with its capacity report, then the
+# sim bench (scale wall-clock pin + legacy-vs-debit pick rule A/B)
+sim-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sim.py tests/test_policies.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/sim_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --sim
 
 # observability smoke: the spans/stepstats/prometheus/request-tracing suite,
 # then the span-overhead micro-bench (docs/observability.md)

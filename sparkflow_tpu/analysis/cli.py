@@ -15,7 +15,7 @@ import json
 import sys
 from typing import List, Optional, Sequence
 
-from . import ast_lint, lockgraph, locks
+from . import ast_lint, lockgraph, locks, policy_lint
 from .findings import RULES, Finding, format_findings
 
 __all__ = ["main", "run_static", "run_all"]
@@ -23,9 +23,10 @@ __all__ = ["main", "run_static", "run_all"]
 
 def run_static(paths: Sequence[str]) -> List[Finding]:
     """ast_lint + per-class lock coverage + the whole-package lock graph
-    (deadlock/blocking-under-lock) over every .py under ``paths``."""
+    (deadlock/blocking-under-lock) + pure-policy purity over every .py
+    under ``paths``."""
     return (ast_lint.lint_paths(paths) + locks.lint_paths(paths)
-            + lockgraph.lint_paths(paths))
+            + lockgraph.lint_paths(paths) + policy_lint.lint_paths(paths))
 
 
 def run_all(paths: Sequence[str], trace: bool = True,

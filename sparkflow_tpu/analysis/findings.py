@@ -93,6 +93,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                 "data-dependent cond/while — if devices disagree on the "
                 "predicate, some enter the collective and some don't, and "
                 "the mesh hangs"),
+    # policy purity (GC-S5xx): modules marked `# graftcheck: pure-policy`
+    "GC-S501": ("impure-policy",
+                "wall-clock, randomness, sleeping, or socket/file I/O "
+                "inside a module marked pure-policy — the simulator "
+                "replays these decisions in virtual time, so any impurity "
+                "silently forks sim behavior from production"),
     "GC-J108": ("full-pool-dequant",
                 "a convert_element_type widens the entire quantized KV page "
                 "pool to float before the page gather — a full-precision "

@@ -64,12 +64,14 @@ router + replicas end to end; ``make decode-smoke`` does the same for
 continuous-batching generation.
 """
 
+from . import policies
 from .batcher import ContinuousBatcher, Draining, MicroBatcher, QueueFull
 from .client import ConnectionPool, ServingClient, ServingError
 from .decode import DecodeEngine
 from .engine import InferenceEngine
 from .kvcache import OutOfPages, PagedKVCache
 from .membership import BreakerState, CircuitBreaker, Membership, Replica
+from .policies import ReplicaView, VersionStats
 from .router import (CanaryController, ResultCache, RouterServer,
                      TokenBucket)
 from .server import InferenceServer
@@ -81,4 +83,4 @@ __all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
            "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache",
            "DecodeEngine", "ContinuousBatcher", "PagedKVCache",
            "OutOfPages", "WeightStore", "WeightWatcher", "WeightStoreError",
-           "CanaryController"]
+           "CanaryController", "policies", "ReplicaView", "VersionStats"]

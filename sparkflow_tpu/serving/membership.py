@@ -47,7 +47,9 @@ from urllib.parse import urlsplit
 
 from ..utils import metrics as metrics_mod
 from ..utils import quant
+from . import policies
 from .client import ConnectionPool, ServingClient, ServingError
+from .policies import ReplicaView
 
 __all__ = ["BreakerState", "CircuitBreaker", "Replica", "Membership"]
 
@@ -200,6 +202,17 @@ class Replica:
         # live-weight version from /healthz ("serving_version"); -1 = not
         # yet probed. Canary dispatch keys on this.
         self.version = -1
+        # when (by `clock`) the last successful probe harvested the load
+        # figures above; 0.0 = never probed. The pick degrades stale load
+        # reports to "unknown" via policies.probe_is_stale, and the
+        # injectable clock lets the simulator/tests drive that check in
+        # virtual time.
+        self.clock = clock
+        self.last_probe_t = 0.0
+        # cumulative dispatches ever sent here; the pure pick's
+        # equal-load tie-break (least-served first), so ties spread
+        # instead of always landing on the lowest index
+        self.dispatched = 0
         self.successes = 0
         self.failures = 0
         self.hedges = 0              # hedge requests sent to this replica
@@ -288,6 +301,7 @@ class Membership:
             replica.healthy = ok
             replica.last_probe_error = err
             if ok:
+                replica.last_probe_t = self._clock()
                 replica.queue_depth = int(body.get("queue_depth", 0))
                 replica.reported_in_flight = int(body.get("in_flight", 0))
                 try:
@@ -338,47 +352,54 @@ class Membership:
 
     # -- dispatch bookkeeping ------------------------------------------------
 
+    def view_of(self, replica: Replica, now: Optional[float] = None
+                ) -> ReplicaView:
+        """Frozen policy-layer snapshot of one replica. Caller holds
+        ``self._lock``. A stale probe report (older than 3 probe intervals
+        by the injectable clock — a wedged prober) degrades the load
+        figures to unknown rather than freezing old 'idle' numbers into
+        every pick."""
+        stale = policies.probe_is_stale(
+            replica.last_probe_t,
+            self._clock() if now is None else now,
+            self.probe_interval_s)
+        return ReplicaView(
+            index=replica.index, healthy=replica.healthy,
+            inflight=replica.inflight,
+            queue_depth=0 if stale else replica.queue_depth,
+            decode_free_slots=-1 if stale else replica.decode_free_slots,
+            decode_pages_free=-1 if stale else replica.decode_pages_free,
+            kv_bytes_per_page=replica.kv_bytes_per_page,
+            version=replica.version, dispatched=replica.dispatched)
+
     def pick(self, exclude: Sequence[Replica] = (),
              signal: str = "predict") -> Optional[Replica]:
         """Least-loaded live replica (healthy + breaker allows), or None.
         ``exclude`` skips replicas already tried for this request (reroute)
         or already carrying its primary attempt (hedge).
 
-        ``signal`` selects the load metric. ``"predict"`` (default) is the
-        classic least-loaded order: router-side in-flight, tie-broken by
-        replica queue depth. ``"generate"`` routes by **KV headroom**: a
-        decode replica's real capacity is free slots/pages, not queue depth
-        — a replica with a short queue but zero free pages would 503 every
-        admission. Page- or slot-starved replicas sort last (still
-        dispatchable as a last resort: replica-side admission turns it into
-        explicit backpressure), the rest order by router in-flight then most
-        EFFECTIVE capacity free: pages_free weighted by the replica's
-        ``kv_bytes_per_page``, so a mixed bf16/int8 fleet compares the
-        bytes each replica can still hold, not raw page counts (an int8
-        replica's page holds the same tokens in half the bytes — equal
-        pages_free means it is the roomier target and its probe reports
-        ~2x the page count for the same device budget). Replicas that have
-        not reported a byte figure weight 1 (raw pages); unknown headroom
-        (-1) sorts after known ones at equal in-flight."""
-        skip = set(id(r) for r in exclude)
-
-        if signal == "generate":
-            def key(r):
-                starved = 1 if (r.decode_pages_free == 0
-                                or r.decode_free_slots == 0) else 0
-                bpp = r.kv_bytes_per_page if r.kv_bytes_per_page > 0 else 1
-                free = (r.decode_pages_free * bpp
-                        if r.decode_pages_free > 0 else r.decode_pages_free)
-                return (starved, r.inflight, -free, r.index)
-        else:
-            def key(r):
-                return (r.inflight, r.queue_depth, r.index)
-
+        The *decision* lives in :mod:`~sparkflow_tpu.serving.policies`
+        (pure functions over :class:`ReplicaView` snapshots — the same
+        code the fleet simulator replays): ``"predict"`` ranks by
+        router-side in-flight then replica queue depth
+        (:func:`policies.predict_pick_key`); ``"generate"`` ranks by
+        **byte-headroom weighted load** — occupancy per effective free KV
+        byte, ``pages_free x kv_bytes_per_page``, so a heterogeneous
+        bf16/int8 fleet loads replicas proportionally to the bytes each
+        can still hold, with page-/slot-starved replicas last (still
+        dispatchable as a final resort: replica-side admission turns it
+        into explicit backpressure) and unknown headroom after known
+        (:func:`policies.generate_pick_key`). Equal-load ties go to the
+        replica with the fewest cumulative dispatches (self-balancing)
+        instead of always the lowest index."""
+        skip = {id(r) for r in exclude}
         with self._lock:
-            ordered = sorted(
-                (r for r in self._replicas
-                 if id(r) not in skip and r.healthy),
-                key=key)
+            now = self._clock()
+            candidates = {r.index: r for r in self._replicas
+                          if id(r) not in skip}
+            views = [self.view_of(r, now) for r in candidates.values()]
+            order = policies.pick_order(views, signal=signal)
+            ordered = [candidates[i] for i in order]
             versions = {id(r): r.version for r in ordered}
         if self.version_policy is not None and ordered:
             # canary weighting + quarantine exclusion, applied to the
@@ -397,6 +418,7 @@ class Membership:
     def begin_dispatch(self, replica: Replica, hedge: bool = False) -> None:
         with self._lock:
             replica.inflight += 1
+            replica.dispatched += 1
             if hedge:
                 replica.hedges += 1
 
@@ -456,7 +478,7 @@ class Membership:
                          mesh_shape=r.mesh_shape, tp=r.tp, ep=r.ep, pp=r.pp,
                          kv_dtype=r.kv_dtype,
                          kv_bytes_per_page=r.kv_bytes_per_page,
-                         version=r.version,
+                         version=r.version, last_probe_t=r.last_probe_t,
                          successes=r.successes, failures=r.failures,
                          hedges=r.hedges, last_probe_error=r.last_probe_error)
                     for r in self._replicas]

@@ -59,8 +59,10 @@ from ..resilience import faults
 from ..resilience.lifecycle import Lifecycle, ServerState
 from ..resilience.retry import RetryPolicy
 from ..utils import metrics as metrics_mod
+from . import policies
 from .client import _STALE_CONN_ERRORS
 from .membership import Membership, Replica
+from .policies import VersionStats
 
 __all__ = ["RouterServer", "TokenBucket", "ResultCache", "CanaryController"]
 
@@ -84,15 +86,13 @@ class TokenBucket:
         self._last = clock()
 
     def try_acquire(self, n: float = 1.0) -> bool:
+        # the refill/spend arithmetic is the pure policy; this shell owns
+        # the lock and the clock read (policies never touch wall time)
         with self._lock:
-            now = self.clock()
-            self._tokens = min(self.burst,
-                               self._tokens + (now - self._last) * self.rate)
-            self._last = now
-            if self._tokens >= n:
-                self._tokens -= n
-                return True
-            return False
+            ok, self._tokens, self._last = policies.token_bucket_admit(
+                self._tokens, self._last, self.clock(),
+                rate=self.rate, burst=self.burst, n=n)
+            return ok
 
 
 class ResultCache:
@@ -241,10 +241,7 @@ class CanaryController:
 
     @staticmethod
     def _p95(lat: List[float]) -> float:
-        if not lat:
-            return 0.0
-        s = sorted(lat)
-        return s[min(len(s) - 1, int(round(0.95 * (len(s) - 1))))]
+        return policies.percentile_nearest_rank(lat, 95.0)
 
     # -- the gate ------------------------------------------------------------
 
@@ -283,32 +280,35 @@ class CanaryController:
                 logger.exception("canary: weight-store rollback for "
                                  "version %d failed", bad)
 
+    @staticmethod
+    def _version_stats(st: Optional[Dict[str, Any]]
+                       ) -> Optional[VersionStats]:
+        if st is None:
+            return None
+        return VersionStats(requests=st["requests"], errors=st["errors"],
+                            nans=st["nans"], latencies_ms=tuple(st["lat"]))
+
     def _gate_locked(self, st: Dict[str, Any]) -> Optional[int]:
         """Judge the canary; returns the version to roll back, or None
-        (still trialling, or promoted). Caller holds ``self._lock``."""
+        (still trialling, or promoted). Caller holds ``self._lock``. The
+        verdict itself is :func:`policies.canary_gate` — the pure function
+        the fleet simulator replays; this shell applies its side effects
+        (promotion bookkeeping, quarantine, metrics)."""
         v = self.canary
-        if st["nans"]:
-            return self._rollback_locked(v, "NaN/Inf outputs")
-        if st["requests"] < self.min_requests:
-            return None
-        inc = self._stats.get(self.incumbent)
-        inc_req = inc["requests"] if inc else 0
-        inc_err = (inc["errors"] / inc_req) if inc_req else 0.0
-        err = st["errors"] / st["requests"]
-        if err > inc_err + self.error_rate_margin:
-            return self._rollback_locked(
-                v, f"error rate {err:.3f} vs incumbent {inc_err:.3f}")
-        inc_p95 = self._p95(inc["lat"]) if inc else 0.0
-        if inc_p95 > 0.0:
-            p95 = self._p95(st["lat"])
-            bar = max(self.latency_floor_ms, self.latency_factor * inc_p95)
-            if p95 > bar:
-                return self._rollback_locked(
-                    v, f"latency p95 {p95:.1f}ms > {bar:.1f}ms")
-        logger.info("canary: promoting version %d to incumbent "
-                    "(was %s)", v, self.incumbent)
-        self.incumbent, self.canary = v, None
-        self.promotions += 1
+        verdict, reason = policies.canary_gate(
+            self._version_stats(st),
+            self._version_stats(self._stats.get(self.incumbent)),
+            min_requests=self.min_requests,
+            error_rate_margin=self.error_rate_margin,
+            latency_factor=self.latency_factor,
+            latency_floor_ms=self.latency_floor_ms)
+        if verdict == policies.GATE_ROLLBACK:
+            return self._rollback_locked(v, reason)
+        if verdict == policies.GATE_PROMOTE:
+            logger.info("canary: promoting version %d to incumbent "
+                        "(was %s)", v, self.incumbent)
+            self.incumbent, self.canary = v, None
+            self.promotions += 1
         return None
 
     def _rollback_locked(self, v: int, reason: str) -> int:
@@ -327,21 +327,21 @@ class CanaryController:
         Quarantined versions are dropped outright (zero post-gate traffic —
         an all-quarantined fleet yields [] and the router 503s rather than
         serve bad weights); with a canary under trial, ~``canary_fraction``
-        of picks put the canary group first, the rest put it last."""
+        of picks put the canary group first, the rest put it last. The
+        reorder itself is :func:`policies.canary_reorder`; the random
+        canary-fraction coin is drawn HERE (policies take it pre-drawn —
+        no randomness inside the pure layer)."""
         with self._lock:
             for v in sorted({version_of(r) for r in replicas}):
                 self._note_version_locked(v)
-            q = set(self.quarantined)
+            q = frozenset(self.quarantined)
             canary = self.canary
             prefer_canary = self._rng.random() < self.canary_fraction
-        live = [r for r in replicas if version_of(r) not in q]
-        if canary is None:
-            return live
-        cgroup = [r for r in live if version_of(r) == canary]
-        rest = [r for r in live if version_of(r) != canary]
-        if not cgroup or not rest:
-            return live
-        return cgroup + rest if prefer_canary else rest + cgroup
+        by_pos = {i: r for i, r in enumerate(replicas)}
+        versions = {i: version_of(r) for i, r in enumerate(replicas)}
+        order = policies.canary_reorder(list(by_pos), versions, canary, q,
+                                        prefer_canary)
+        return [by_pos[i] for i in order]
 
     # -- introspection -------------------------------------------------------
 
@@ -475,6 +475,7 @@ class RouterServer:
                  canary_error_margin: float = 0.05,
                  canary_latency_factor: float = 2.0,
                  weight_store=None,
+                 clock=time.monotonic,
                  metrics: Optional[metrics_mod.Metrics] = None,
                  tracer: Optional[spans_mod.Tracer] = None):
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
@@ -494,13 +495,15 @@ class RouterServer:
             replica_urls, probe_interval_s=probe_interval_s,
             probe_timeout_s=probe_timeout_s,
             failure_threshold=failure_threshold, recovery_s=recovery_s,
-            metrics=self.metrics, version_policy=self.canary_ctl)
+            metrics=self.metrics, version_policy=self.canary_ctl,
+            clock=clock)
         self.dispatch_retries = int(dispatch_retries)
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=self.dispatch_retries + 1, base_s=0.05,
             multiplier=2.0, max_s=0.5, jitter=0.5, seed=0)
         self.max_inflight = int(max_inflight)
-        self.bucket = (TokenBucket(admission_rate, admission_burst)
+        self.bucket = (TokenBucket(admission_rate, admission_burst,
+                                   clock=clock)
                        if admission_rate is not None else None)
         self.hedge = bool(hedge)
         self.hedge_delay_ms = hedge_delay_ms
@@ -627,29 +630,29 @@ class RouterServer:
                     "replica": replica, "hedge": is_hedge}
         finally:
             self.membership.end_dispatch(replica)
-        if status == 200:
+        # what the outcome MEANS (eject / reroute / breaker-feed / pass
+        # through) is the pure policy; the side effects stay here
+        code = (obj.get("error") or {}).get("code", "")
+        verdict = policies.classify_outcome(status, code)
+        if verdict == policies.OUTCOME_SUCCESS:
             self.membership.record_success(replica)
             return {"ok": True, "status": 200, "obj": obj,
                     "replica": replica, "hedge": is_hedge}
-        code = (obj.get("error") or {}).get("code", "")
-        if status == 503 and code == "draining":
+        if verdict == policies.OUTCOME_EJECT:
             # the replica caught SIGTERM: out of rotation NOW, reroute
             self.membership.eject(replica, "draining 503")
-            return {"ok": False, "retryable": True, "status": status,
-                    "obj": obj, "replica": replica, "hedge": is_hedge}
-        if status == 503:
+        elif verdict == policies.OUTCOME_REROUTE:
             # queue_full: overloaded, not broken — reroute without feeding
             # the breaker (least-loaded pick already steers away)
             self.metrics.incr("router/replica_queue_full")
-            return {"ok": False, "retryable": True, "status": status,
-                    "obj": obj, "replica": replica, "hedge": is_hedge}
-        if status >= 500:
+        elif verdict == policies.OUTCOME_FAILURE:
             self.membership.record_failure(replica, f"http {status}")
-            return {"ok": False, "retryable": True, "status": status,
-                    "obj": obj, "replica": replica, "hedge": is_hedge}
-        # 4xx: the request is wrong, not the replica — pass through verbatim
-        return {"ok": False, "retryable": False, "status": status,
-                "obj": obj, "replica": replica, "hedge": is_hedge}
+        # OUTCOME_CLIENT_ERROR (4xx): the request is wrong, not the
+        # replica — pass through verbatim, no retry
+        return {"ok": False,
+                "retryable": verdict != policies.OUTCOME_CLIENT_ERROR,
+                "status": status, "obj": obj, "replica": replica,
+                "hedge": is_hedge}
 
     def _attempt(self, primary: Replica, body: bytes,
                  headers: Dict[str, str],

@@ -644,3 +644,75 @@ def test_cli_json_format(tmp_path, capsys):
     good.write_text("def f(x):\n    return x\n")
     assert cli_main([str(good), "--no-trace", "--format", "json"]) == 0
     assert capsys.readouterr().out.strip() == ""
+
+
+# ---------------------------------------------------------------------------
+# policy_lint: planted defects both directions + repo-clean gate (GC-S501)
+# ---------------------------------------------------------------------------
+
+
+def test_s501_impure_policy_detected():
+    # planted defects: every category of impurity inside a marked module
+    # must be flagged with the documented rule id
+    from sparkflow_tpu.analysis import policy_lint
+
+    src = textwrap.dedent("""\
+        # graftcheck: pure-policy
+        import time
+        import random as rnd
+        from socket import create_connection
+
+        def decide(views):
+            now = time.monotonic()
+            coin = rnd.random()
+            create_connection(("h", 80))
+            open("/tmp/x")
+            client.sleep(1.0)
+            return now + coin
+    """)
+    fs = policy_lint.lint_source(src, "planted.py")
+    assert fs and rules_of(fs) == {"GC-S501"}
+    lines = {f.line for f in fs}
+    # imports (2, 3, 4), time call (7), random call (8), socket call (9),
+    # open (10), .sleep (11)
+    assert {2, 3, 4, 7, 8, 9, 10, 11} <= lines
+
+
+def test_s501_clean_and_unmarked_not_flagged():
+    # the other direction: pure code in a marked module is clean, and an
+    # unmarked module may be as impure as it likes (out of scope)
+    from sparkflow_tpu.analysis import policy_lint
+
+    pure = textwrap.dedent("""\
+        # graftcheck: pure-policy
+        from dataclasses import dataclass
+
+        def pick(views, now, prefer_canary):
+            return sorted(v.index for v in views if v.healthy)
+    """)
+    assert policy_lint.lint_source(pure, "pure.py") == []
+    impure_unmarked = "import time\n\ndef f():\n    return time.time()\n"
+    assert policy_lint.lint_source(impure_unmarked, "um.py") == []
+    # standard suppression syntax applies
+    suppressed = textwrap.dedent("""\
+        # graftcheck: pure-policy
+        import time  # graftcheck: disable=GC-S501
+
+        def f(x):
+            return x
+    """)
+    assert policy_lint.lint_source(suppressed, "sup.py") == []
+
+
+def test_s501_policy_module_repo_clean():
+    # the real policy module carries the marker and must stay pure; the
+    # full static pass (which now includes policy_lint) agrees
+    from sparkflow_tpu.analysis import policy_lint
+    from sparkflow_tpu.serving import policies as policies_mod
+
+    path = policies_mod.__file__
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    assert policy_lint.PURE_POLICY_MARKER in src.splitlines()[0]
+    assert policy_lint.lint_file(path) == []
+    assert [f for f in run_static([path])] == []

@@ -216,6 +216,7 @@ def test_generate_pick_prefers_kv_headroom():
     assert m.pick(exclude=[rc], signal="generate") is rb
     assert m.pick(exclude=[rb, rc], signal="generate") is ra  # last resort
     rc.queue_depth = 50
+    rb.queue_depth = 1   # strict predict order: break the equal-load tie
     assert m.pick(signal="generate") is rc  # queue depth is not the signal
     assert m.pick(signal="predict") is ra   # predict ranking unchanged
     rb.decode_pages_free = -1               # unknown sorts after known
