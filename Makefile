@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke scale-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -164,6 +164,18 @@ kvquant-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_kvquant.py -q
 	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/kvquant_smoke.py
 	JAX_PLATFORMS=cpu python bench.py --kv-quant
+
+# elastic autoscaling smoke: the autoscaler test battery (policy units,
+# sim step response, live control loop, real-subprocess supervisor), then
+# a real 1->3->1 fleet: load step up spawns replicas (zero-compile boot
+# from the shared executable store), a SIGKILL mid-burst is reaped and
+# replaced within one tick, the trickle phase drains back to min — zero
+# client-visible failures throughout; finishes with the cold-start
+# boot-to-first-token benchmark (docs/serving.md)
+scale-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_autoscaler.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/scale_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --cold-start
 
 # fleet-simulator smoke: the sim + policy-parity test suites, then the
 # 1000-replica x 1M-request what-if with its capacity report, then the

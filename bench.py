@@ -1658,6 +1658,111 @@ def sim_main():
     print(json.dumps(out))
 
 
+def cold_start_main():
+    """Zero-compile cold start bench: boot-to-first-token with vs without
+    the serialized-executable store. Prints ONE JSON line:
+    {"metric": "cold_start_boot", ...}.
+
+    Three boots of the same engines (a predict MLP bucket ladder and a
+    transformer DecodeEngine), same process, same machine:
+
+    1. **populate** — boot with an empty ``ExecutableStore`` directory:
+       full compiles, store saves every executable (untimed);
+    2. **compile boot** — boot with NO store: every executable pays
+       tracing + lowering + XLA (the status quo a spawned replica paid
+       before this store existed);
+    3. **serialized boot** — boot against the populated store: every
+       executable deserializes (``coldstart/hits``), zero compiles.
+
+    Boot time = constructor (which warms up the full AOT ladder) + the
+    first real result (a predict / a prefill + one decode step). The
+    pinned claim for BENCH_NOTES.md is the compile/serialized ratio; the
+    elastic-fleet value is that this latency sits between "autoscaler
+    ordered capacity" and "capacity takes traffic".
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    from sparkflow_tpu.serving import DecodeEngine, InferenceEngine
+    from sparkflow_tpu.utils.metrics import Metrics
+
+    spec = build_registry_spec("transformer_lm", vocab_size=64, hidden=64,
+                               num_layers=4, num_heads=4, mlp_dim=256,
+                               max_len=64, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+
+    import sparkflow_tpu.nn as nn
+    from sparkflow_tpu.graph_utils import build_graph
+
+    def mlp_graph():
+        x = nn.placeholder([None, 8], name="x")
+        h = nn.dense(x, 16, activation="relu")
+        nn.mean_squared_error(x, nn.dense(h, 4, name="out"))
+
+    rs = np.random.RandomState(0)
+    weights = [rs.randn(8, 16).astype(np.float32),
+               rs.randn(16).astype(np.float32),
+               rs.randn(16, 4).astype(np.float32),
+               rs.randn(4).astype(np.float32)]
+
+    def boot_predict(exe_dir):
+        t0 = time.perf_counter()
+        eng = InferenceEngine(build_graph(mlp_graph), weights,
+                              input_name="x:0",
+                              output_name="out/BiasAdd:0", max_batch=8,
+                              executable_dir=exe_dir)
+        eng.predict(np.zeros((3, 8), np.float32))
+        return time.perf_counter() - t0, eng
+
+    def boot_decode(exe_dir):
+        t0 = time.perf_counter()
+        eng = DecodeEngine(model, params, num_slots=4, page_size=8,
+                           num_pages=64, seed=0, metrics=Metrics(),
+                           executable_dir=exe_dir)
+        info = eng.prefill([5, 9, 2], max_new_tokens=2, temperature=0.0)
+        eng.step()
+        eng.release(info["slot"])
+        return time.perf_counter() - t0, eng
+
+    exe_dir = tempfile.mkdtemp(prefix="coldstart_bench_")
+    try:
+        boot_predict(exe_dir)          # populate (compile + save)
+        boot_decode(exe_dir)
+        p_cold_s, _ = boot_predict(None)         # full-compile boots
+        d_cold_s, _ = boot_decode(None)
+        p_warm_s, p_eng = boot_predict(exe_dir)  # serialized boots
+        d_warm_s, d_eng = boot_decode(exe_dir)
+        p_loads = p_eng.stats()["cold_start"]["serialized_loads"]
+        d_loads = d_eng.stats()["cold_start"]["serialized_loads"]
+    finally:
+        shutil.rmtree(exe_dir, ignore_errors=True)
+
+    # the claim: serialized boot is measurably below full-compile boot
+    ok = (p_warm_s < p_cold_s and d_warm_s < d_cold_s
+          and p_loads > 0 and d_loads > 0)
+    out = {
+        "metric": "cold_start_boot",
+        "predict_compile_boot_s": round(p_cold_s, 4),
+        "predict_serialized_boot_s": round(p_warm_s, 4),
+        "predict_speedup": round(p_cold_s / max(p_warm_s, 1e-9), 2),
+        "predict_serialized_loads": int(p_loads),
+        "decode_compile_boot_s": round(d_cold_s, 4),
+        "decode_serialized_boot_s": round(d_warm_s, 4),
+        "decode_speedup": round(d_cold_s / max(d_warm_s, 1e-9), 2),
+        "decode_serialized_loads": int(d_loads),
+        "serialized_faster": bool(ok),
+        "platform": "cpu",
+    }
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if "--span-overhead" in sys.argv:
         span_overhead_main()
@@ -1683,5 +1788,7 @@ if __name__ == "__main__":
         dp_zero3_main()
     elif "--sim" in sys.argv:
         sim_main()
+    elif "--cold-start" in sys.argv:
+        cold_start_main()
     else:
         main()

@@ -47,6 +47,18 @@ users") requires:
   (pass the batcher to ``InferenceServer(generate_batcher=...)``) with the
   same backpressure, drain, and request-id contract as predict.
 
+- :class:`~sparkflow_tpu.serving.autoscaler.Autoscaler` /
+  :class:`~sparkflow_tpu.serving.autoscaler.ReplicaManager` — the
+  self-healing elastic fleet: a daemon that reads queue-wait p95 and
+  per-replica capacity gauges, feeds them to the pure
+  :func:`~sparkflow_tpu.serving.policies.scale_decision` (hysteresis
+  bands + cooldowns, tuned in ``sparkflow_tpu.sim``), and spawns /
+  SIGTERM-drains real replica processes, replacing crashed ones within
+  one tick of exit-code reaping.
+  :class:`~sparkflow_tpu.serving.coldstart.ExecutableStore` makes the
+  ordered capacity arrive fast: serialized XLA executables stored next
+  to the weights boot a replica with zero compiles.
+
 - :class:`~sparkflow_tpu.serving.weightstore.WeightStore` /
   :class:`~sparkflow_tpu.serving.weightstore.WeightWatcher` — live weight
   publication: immutable, monotonically versioned weight sets published
@@ -65,8 +77,10 @@ continuous-batching generation.
 """
 
 from . import policies
+from .autoscaler import Autoscaler, ReplicaManager
 from .batcher import ContinuousBatcher, Draining, MicroBatcher, QueueFull
 from .client import ConnectionPool, ServingClient, ServingError
+from .coldstart import ExecutableStore
 from .decode import DecodeEngine
 from .engine import InferenceEngine
 from .kvcache import OutOfPages, PagedKVCache
@@ -83,4 +97,5 @@ __all__ = ["InferenceEngine", "MicroBatcher", "QueueFull", "Draining",
            "CircuitBreaker", "BreakerState", "TokenBucket", "ResultCache",
            "DecodeEngine", "ContinuousBatcher", "PagedKVCache",
            "OutOfPages", "WeightStore", "WeightWatcher", "WeightStoreError",
-           "CanaryController", "policies", "ReplicaView", "VersionStats"]
+           "CanaryController", "policies", "ReplicaView", "VersionStats",
+           "Autoscaler", "ReplicaManager", "ExecutableStore"]

@@ -1,0 +1,291 @@
+"""Zero-compile cold start: serialized XLA executables next to the weights.
+
+Boot-to-first-token for a fresh replica is dominated by compilation: the
+predict bucket ladder plus ``DecodeEngine``'s prefill ladder / step /
+fused-chunk shapes each cost an XLA compile, and even a *cache-hit*
+compile (the PR 8 persistent compile cache) still pays tracing, lowering,
+and cache I/O per executable. The autoscaler makes this latency
+load-bearing — capacity ordered at the band edge arrives only after the
+new replica finishes warming up — so this module removes the compile
+entirely: :class:`ExecutableStore` persists the *compiled executable*
+(``jax.experimental.serialize_executable``, the serialization layer under
+``jax.export``) next to the checkpoint/WeightStore manifests, and warmup
+loads it back in milliseconds.
+
+Three boot tiers, best effort downward (per executable, not per process):
+
+1. **serialized** — ``ExecutableStore.load`` deserializes the stored
+   executable; zero tracing, zero XLA. Guarded by a sha256 over the
+   payload (a torn write must not boot) and an environment fingerprint
+   (jax version + backend + device count — XLA executables are not
+   portable across any of those).
+2. **compile cache** — a live ``lower().compile()`` that hits the
+   persistent compile cache (``compile_cache_dir=``).
+3. **live compile** — the full XLA pipeline; the store then saves the
+   result so the NEXT boot takes tier 1.
+
+Layout (one directory, e.g. ``<weights_dir>/executables``)::
+
+    executables.json          # manifest: key -> {file, sha256, env}
+    predict_b8.exe            # pickled (payload, in_tree, out_tree)
+    decode_step.exe
+    ...
+
+Writes are atomic (temp file + rename, manifest last) so a crash
+mid-save leaves the previous manifest intact — the same discipline as
+``WeightStore`` — and the blob write + manifest read-modify-write run
+under an ``O_EXCL`` lock file, so concurrent replica boots against one
+shared store cannot drop each other's manifest entries (stale locks from
+crashed writers are broken; a busy lock degrades to an unlocked update). Everything here degrades to a miss: an unsupported
+backend, a stale fingerprint, a corrupt file, or an ImportError on the
+serialization API all return ``None`` from :meth:`ExecutableStore.load`
+and the caller falls through to the next tier.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+from ..utils import metrics as metrics_mod
+
+__all__ = ["ExecutableStore", "MANIFEST_NAME", "env_fingerprint"]
+
+MANIFEST_NAME = "executables.json"
+
+# manifest-lock tuning: how long save() waits for a peer's update before
+# proceeding unlocked (degrades to the lost-update race, never worse),
+# and how old an abandoned lock must be before it is presumed to belong
+# to a crashed writer and broken
+LOCK_TIMEOUT_S = 5.0
+LOCK_STALE_S = 30.0
+
+logger = logging.getLogger("sparkflow_tpu")
+
+
+def env_fingerprint() -> str:
+    """What a serialized executable is valid for: jax version, backend
+    platform, and device count. Any change invalidates the store (the
+    fallback tiers take over) — deserializing an executable compiled for
+    different hardware is undefined at best."""
+    import jax
+    return (f"jax-{jax.__version__}/{jax.default_backend()}"
+            f"/d{jax.device_count()}")
+
+
+def _serialize_api():
+    """The (serialize, deserialize_and_load) pair, or None when this jax
+    build doesn't ship executable serialization."""
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+        return serialize, deserialize_and_load
+    except Exception:  # noqa: BLE001 - absent/renamed API = tier unavailable
+        return None
+
+
+class ExecutableStore:
+    """sha256-manifested store of serialized XLA executables.
+
+    ``load``/``save`` never raise for storage or serialization problems —
+    cold start must boot through every failure mode, just slower. The
+    ``metrics`` counters (``coldstart/{hits,misses,saves,rejects}``) say
+    which tier a boot actually took.
+    """
+
+    def __init__(self, directory: str, *,
+                 metrics: Optional[metrics_mod.Metrics] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.metrics = (metrics if metrics is not None
+                        else metrics_mod.Metrics())
+        self._env = None  # computed lazily: importing jax is not free
+
+    # -- manifest ------------------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    def _read_manifest(self) -> Dict[str, Any]:
+        try:
+            with open(self.manifest_path, "r", encoding="utf-8") as fh:
+                m = json.load(fh)
+            return m if isinstance(m, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _write_manifest(self, manifest: Dict[str, Any]) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   prefix=".manifest-", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(manifest, fh, indent=1, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.manifest_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    @property
+    def _lock_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME + ".lock")
+
+    @contextlib.contextmanager
+    def _manifest_lock(self):
+        """Cross-process mutual exclusion for the manifest read-modify-
+        write. A scale-up boots several replica processes against one
+        shared store; two unlocked concurrent first-boots would each
+        rewrite the manifest from their own snapshot and silently drop
+        the other's entries (last writer wins), defeating the shared
+        warm boot. O_EXCL lock file; a lock older than ``LOCK_STALE_S``
+        is presumed left by a crashed writer and broken; past
+        ``LOCK_TIMEOUT_S`` the update proceeds unlocked (the pre-lock
+        behavior — a recompile on a later boot, never corruption)."""
+        deadline = time.monotonic() + LOCK_TIMEOUT_S
+        acquired = False
+        while True:
+            try:
+                fd = os.open(self._lock_path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                acquired = True
+                break
+            except FileExistsError:
+                try:
+                    age = time.time() - os.stat(self._lock_path).st_mtime
+                except OSError:
+                    continue            # holder just released; retry now
+                if age > LOCK_STALE_S:
+                    logger.warning("coldstart: breaking stale manifest "
+                                   "lock (%.0fs old)", age)
+                    try:
+                        os.unlink(self._lock_path)
+                    except OSError:
+                        pass
+                    continue
+                if time.monotonic() >= deadline:
+                    logger.warning(
+                        "coldstart: manifest lock held past %.0fs; "
+                        "updating unlocked", LOCK_TIMEOUT_S)
+                    break
+                time.sleep(0.02)
+            except OSError:
+                break                   # unwritable dir: best effort
+        try:
+            yield
+        finally:
+            if acquired:
+                try:
+                    os.unlink(self._lock_path)
+                except OSError:
+                    pass
+
+    def keys(self):
+        return sorted(self._read_manifest())
+
+    def _fingerprint(self) -> str:
+        if self._env is None:
+            self._env = env_fingerprint()
+        return self._env
+
+    @staticmethod
+    def _filename(key: str) -> str:
+        return key.replace("/", "_").replace(":", "_") + ".exe"
+
+    # -- tiers ---------------------------------------------------------------
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize one compiled executable under ``key``. Returns True
+        on success; False (logged, counted) when serialization or the
+        write fails — the store is an accelerator, never a gate."""
+        api = _serialize_api()
+        if api is None:
+            return False
+        serialize, _ = api
+        try:
+            payload, in_tree, out_tree = serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:  # noqa: BLE001 - unsupported executable
+            logger.info("coldstart: cannot serialize %s (%s)", key, exc)
+            return False
+        fname = self._filename(key)
+        try:
+            # blob write AND manifest read-modify-write under one lock:
+            # concurrent first-boots of a replica fleet must not rewrite
+            # the shared manifest from divergent snapshots (lost entries)
+            # or cross a peer's blob with this writer's checksum
+            with self._manifest_lock():
+                fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                           prefix=".exe-", suffix=".tmp")
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(blob)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, os.path.join(self.directory, fname))
+                manifest = self._read_manifest()
+                manifest[key] = {
+                    "file": fname,
+                    "sha256": hashlib.sha256(blob).hexdigest(),
+                    "env": self._fingerprint(),
+                    "bytes": len(blob),
+                }
+                self._write_manifest(manifest)
+        except OSError as exc:
+            logger.warning("coldstart: cannot store %s (%s)", key, exc)
+            return False
+        self.metrics.incr("coldstart/saves")
+        return True
+
+    def load(self, key: str):
+        """Deserialize the executable stored under ``key``; None on any
+        kind of miss (absent, stale environment, checksum mismatch,
+        deserialization failure) — callers fall through to a compile."""
+        api = _serialize_api()
+        if api is None:
+            self.metrics.incr("coldstart/misses")
+            return None
+        _, deserialize_and_load = api
+        entry = self._read_manifest().get(key)
+        if not isinstance(entry, dict):
+            self.metrics.incr("coldstart/misses")
+            return None
+        if entry.get("env") != self._fingerprint():
+            # different jax/backend/devices: stale by construction
+            self.metrics.incr("coldstart/rejects")
+            return None
+        try:
+            with open(os.path.join(self.directory,
+                                   str(entry.get("file"))), "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            self.metrics.incr("coldstart/misses")
+            return None
+        if hashlib.sha256(blob).hexdigest() != entry.get("sha256"):
+            logger.warning("coldstart: checksum mismatch for %s; "
+                           "falling back to compile", key)
+            self.metrics.incr("coldstart/rejects")
+            return None
+        try:
+            payload, in_tree, out_tree = pickle.loads(blob)
+            exe = deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001 - any failure = compile tier
+            logger.warning("coldstart: cannot deserialize %s (%s); "
+                           "falling back to compile", key, exc)
+            self.metrics.incr("coldstart/rejects")
+            return None
+        self.metrics.incr("coldstart/hits")
+        return exe

@@ -92,6 +92,7 @@ futures and drain semantics live in
 
 from __future__ import annotations
 
+import hashlib
 import math
 import threading
 import time
@@ -226,6 +227,7 @@ class DecodeEngine:
                  draft_model=None, draft_params=None,
                  mesh=None, sharding=None, pp_wave: bool = True,
                  kv_quant: Optional[str] = None,
+                 executable_dir: Optional[str] = None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(model, str):
             from ..models import model_from_json
@@ -528,6 +530,37 @@ class DecodeEngine:
             warn_after=len(self.prefill_buckets) + 3
             + (1 if self.prefill_chunk else 0)
             + (1 if self._pp_wave else 0) + spec_shapes)
+        # zero-compile cold start: _aot_locked loads jax.export-serialized
+        # executables from this store before compiling (sha256-manifested;
+        # ExecutableStore) and saves what it compiled for the next boot.
+        # The key embeds a signature over every shape-determining knob, so
+        # a store shared across differently-configured engines never
+        # deserializes a wrong-shaped program.
+        self.exec_store = None
+        self.serialized_loads = 0
+        self.serialized_saves = 0
+        # executables compiled under the engine lock, awaiting store
+        # save-back — flushed after the lock is released (save() waits on
+        # the cross-process manifest lock; that wait must not stall
+        # threads contending the engine lock)
+        self._pending_exec_saves = []
+        self._exec_prefix = ""
+        if executable_dir is not None:
+            from .coldstart import ExecutableStore
+            self.exec_store = ExecutableStore(executable_dir,
+                                              metrics=self.metrics)
+            desc = repr((
+                self.num_slots, self.page_size, int(num_pages),
+                self.max_pages_per_slot, self.max_seq_len, self.max_top_k,
+                self._chunk_width, self.prefill_chunk, self.spec_k,
+                self.draft_layers, self.kv_quant, self._pp_wave,
+                self._tp, self._ep, self._pp,
+                dict(self.mesh.shape) if self.mesh is not None else None,
+                int(model.vocab_size),
+                [(tuple(s.shape), str(s.dtype))
+                 for s in jax.tree.leaves(self._weights_template)]))
+            sig = hashlib.sha256(desc.encode()).hexdigest()[:12]
+            self._exec_prefix = f"decode/{sig}"
         self._prefill_exes: Dict[int, Any] = {}
         self._decode_exe: Any = None
         self._sample_exe: Any = None
@@ -1281,25 +1314,41 @@ class DecodeEngine:
         return jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._k_pool)
 
-    def _aot(self, fn, donate, arg_structs, specs=None, out_specs=None):
+    def _aot_locked(self, fn, donate, arg_structs, specs=None,
+                    out_specs=None, key=None):
         """jit -> lower -> compile one decode-plane executable. With model
         parallelism on (and ``specs`` given), the body wraps in a shard_map
         over the serving mesh — pallas custom calls have no GSPMD
         partitioning rule, so every executable is explicitly per-shard with
         replicated activations — and the inputs carry matching
         NamedShardings. ``tp * ep == 1`` compiles the exact unwrapped
-        program."""
+        program.
+
+        With ``key`` and an executable store configured, the store is the
+        first tier — a deserialized executable skips tracing and XLA
+        entirely (zero-compile cold start) — and anything compiled here is
+        queued for save-back (flushed by ``warmup`` after the engine lock
+        is released)."""
+        if key is not None and self.exec_store is not None:
+            exe = self.exec_store.load(key)
+            if exe is not None:
+                self.serialized_loads += 1
+                return exe
         guard = self.recompile_guard
         if not (self._sharded and specs is not None):
-            return jax.jit(guard.wrap(fn), donate_argnums=donate).lower(
+            exe = jax.jit(guard.wrap(fn), donate_argnums=donate).lower(
                 *arg_structs).compile()
-        from ..jax_compat import shard_map
-        body = shard_map(fn, mesh=self.mesh, in_specs=specs,
-                         out_specs=out_specs, check_vma=False)
-        in_sh = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
-                             specs, is_leaf=lambda x: isinstance(x, P))
-        return jax.jit(guard.wrap(body), in_shardings=in_sh,
-                       donate_argnums=donate).lower(*arg_structs).compile()
+        else:
+            from ..jax_compat import shard_map
+            body = shard_map(fn, mesh=self.mesh, in_specs=specs,
+                             out_specs=out_specs, check_vma=False)
+            in_sh = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                 specs, is_leaf=lambda x: isinstance(x, P))
+            exe = jax.jit(guard.wrap(body), in_shardings=in_sh,
+                          donate_argnums=donate).lower(*arg_structs).compile()
+        if key is not None and self.exec_store is not None:
+            self._pending_exec_saves.append((key, exe))
+        return exe
 
     def warmup(self) -> None:
         """AOT-compile the decode step, the prefill-sampling helper, and
@@ -1307,6 +1356,15 @@ class DecodeEngine:
         recompile regression (GC-R401)."""
         with self._lock:
             self._warmup_locked()
+            pending, self._pending_exec_saves = self._pending_exec_saves, []
+        # save-back AFTER the lock: ExecutableStore.save waits on the
+        # cross-process manifest lock, and that wait must not stall
+        # threads contending the engine lock (GC-L305)
+        saved = sum(1 for key, exe in pending
+                    if self.exec_store.save(key, exe))
+        if saved:
+            with self._lock:
+                self.serialized_saves += saved
 
     def _kv_quant_error_probe_locked(self) -> None:
         """Warmup-time error sample for the ``decode/kv_quant_error`` gauge:
@@ -1388,7 +1446,7 @@ class DecodeEngine:
         psp, pls, R = self._param_specs, self._pool_spec, P()
         if self._decode_exe is None:
             with annotate("serving/decode_compile_step"):
-                self._decode_exe = self._aot(
+                self._decode_exe = self._aot_locked(
                     self._decode_fn, (1, 2),
                     (ps, pool, pool,
                      jax.ShapeDtypeStruct((B,), i32),
@@ -1398,11 +1456,12 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((B,), jnp.float32),
                      jax.ShapeDtypeStruct((B,), i32)),
                     specs=(psp, pls, pls, R, R, R, R, R, R),
-                    out_specs=(R, pls, pls, R))
+                    out_specs=(R, pls, pls, R),
+                    key=f"{self._exec_prefix}/step")
             self.aot_compiles += 1
         if self._sample_exe is None:
             with annotate("serving/decode_compile_sample"):
-                self._sample_exe = self._aot(
+                self._sample_exe = self._aot_locked(
                     self._sample_tokens, (),
                     (jax.ShapeDtypeStruct((1, self.model.vocab_size),
                                           jnp.float32),
@@ -1410,20 +1469,22 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((1,), jnp.float32),
                      jax.ShapeDtypeStruct((1,), i32)),
                     specs=(R, R, R, R),
-                    out_specs=(R, R))
+                    out_specs=(R, R),
+                    key=f"{self._exec_prefix}/sample")
             self.aot_compiles += 1
         for b in self.prefill_buckets:
             if b in self._prefill_exes:
                 continue
             with annotate(f"serving/decode_compile_prefill_b{b}"):
-                self._prefill_exes[b] = self._aot(
+                self._prefill_exes[b] = self._aot_locked(
                     self._prefill_fn(b), (1, 2),
                     (ps, pool, pool,
                      jax.ShapeDtypeStruct((1, b), i32),
                      jax.ShapeDtypeStruct((1,), i32),
                      jax.ShapeDtypeStruct((b // self.page_size,), i32)),
                     specs=(psp, pls, pls, R, R, R),
-                    out_specs=(R, pls, pls))
+                    out_specs=(R, pls, pls),
+                    key=f"{self._exec_prefix}/prefill_b{b}")
             self.aot_compiles += 1
         C = self._chunk_width
         chunk_structs = (
@@ -1433,15 +1494,16 @@ class DecodeEngine:
             jax.ShapeDtypeStruct((maxp,), i32))      # slot's table row
         if self._suffix_exe is None:
             with annotate("serving/decode_compile_suffix"):
-                self._suffix_exe = self._aot(
+                self._suffix_exe = self._aot_locked(
                     self._suffix_fn(), (1, 2),
                     (ps, pool, pool, *chunk_structs),
                     specs=(psp, pls, pls, R, R, R, R),
-                    out_specs=(R, pls, pls))
+                    out_specs=(R, pls, pls),
+                    key=f"{self._exec_prefix}/suffix")
             self.aot_compiles += 1
         if self.prefill_chunk and self._fused_exe is None:
             with annotate("serving/decode_compile_fused"):
-                self._fused_exe = self._aot(
+                self._fused_exe = self._aot_locked(
                     self._fused_fn(), (1, 2),
                     (ps, pool, pool, *chunk_structs,
                      jax.ShapeDtypeStruct((B,), i32),
@@ -1451,14 +1513,15 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((B,), jnp.float32),
                      jax.ShapeDtypeStruct((B,), i32)),
                     specs=(psp, pls, pls, R, R, R, R, R, R, R, R, R, R),
-                    out_specs=(R, R, pls, pls, R))
+                    out_specs=(R, R, pls, pls, R),
+                    key=f"{self._exec_prefix}/fused")
             self.aot_compiles += 1
         if self._pp_wave and self._tick_exe is None:
             xc = jax.ShapeDtypeStruct(self._x_carry.shape,
                                       self._x_carry.dtype)
             pcar = P(self._pp_axis)
             with annotate("serving/decode_compile_wave_tick"):
-                self._tick_exe = self._aot(
+                self._tick_exe = self._aot_locked(
                     self._pp_tick_fn(), (1, 2, 3),
                     (ps, pool, pool, xc,
                      jax.ShapeDtypeStruct((), i32),
@@ -1469,7 +1532,8 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((B,), jnp.float32),
                      jax.ShapeDtypeStruct((B,), i32)),
                     specs=(psp, pls, pls, pcar, R, R, R, R, R, R, R),
-                    out_specs=(R, R, pls, pls, pcar))
+                    out_specs=(R, R, pls, pls, pcar),
+                    key=f"{self._exec_prefix}/wave_tick")
             self.aot_compiles += 1
         if self.spec_k:
             self._warmup_spec_locked(ps, pool, B, maxp)
@@ -1485,7 +1549,7 @@ class DecodeEngine:
         psp, pls, R = self._param_specs, self._pool_spec, P()
         if self._verify_exe is None:
             with annotate("serving/decode_compile_verify"):
-                self._verify_exe = self._aot(
+                self._verify_exe = self._aot_locked(
                     self._verify_fn(), (1, 2),
                     (ps, pool, pool,
                      jax.ShapeDtypeStruct((B, S), i32),      # chunk ids
@@ -1496,22 +1560,24 @@ class DecodeEngine:
                      jax.ShapeDtypeStruct((B,), jnp.float32),
                      jax.ShapeDtypeStruct((B,), i32)),
                     specs=(psp, pls, pls, R, R, R, R, R, R, R),
-                    out_specs=(R, R, pls, pls, R))
+                    out_specs=(R, R, pls, pls, R),
+                    key=f"{self._exec_prefix}/verify")
             self.aot_compiles += 1
         if self._copy_exe is None:
             with annotate("serving/decode_compile_copy"):
-                self._copy_exe = self._aot(
+                self._copy_exe = self._aot_locked(
                     self._copy_pages_fn, (0, 1),
                     (pool, pool,
                      jax.ShapeDtypeStruct((), i32),
                      jax.ShapeDtypeStruct((), i32)),
                     specs=(pls, pls, R, R),
-                    out_specs=(pls, pls))
+                    out_specs=(pls, pls),
+                    key=f"{self._exec_prefix}/copy")
             self.aot_compiles += 1
         if self._draft_model is None:
             if self._draft_exe is None:
                 with annotate("serving/decode_compile_draft"):
-                    self._draft_exe = self._aot(
+                    self._draft_exe = self._aot_locked(
                         self._self_draft_fn(), (1, 2),
                         (ps, pool, pool,
                          jax.ShapeDtypeStruct((B,), i32),    # token
@@ -1519,7 +1585,8 @@ class DecodeEngine:
                          jax.ShapeDtypeStruct((B, maxp), i32),
                          jax.ShapeDtypeStruct((B,), i32)),   # nappend
                         specs=(psp, pls, pls, R, R, R, R),
-                        out_specs=(R, pls, pls))
+                        out_specs=(R, pls, pls),
+                        key=f"{self._exec_prefix}/draft")
                 self.aot_compiles += 1
             return
         dps = jax.tree.map(
@@ -2121,6 +2188,11 @@ class DecodeEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "pending_prefills": len(self._pending),
                 "aot_compiles": self.aot_compiles,
+                "cold_start": (
+                    None if self.exec_store is None else
+                    {"dir": self.exec_store.directory,
+                     "serialized_loads": self.serialized_loads,
+                     "serialized_saves": self.serialized_saves}),
                 "traces": self.recompile_guard.traces,
                 "steady_traces": self.recompile_guard.steady_traces,
                 "steps": self._steps,

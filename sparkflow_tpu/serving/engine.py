@@ -23,6 +23,7 @@ quantizes the full-precision tree once at load and compiles the int8 apply —
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -93,6 +94,13 @@ class InferenceEngine:
         AOT-compile every bucket at construction (default). With
         ``warmup=False``, buckets compile on first use (each counted in
         ``stats()['fallback_compiles']``).
+    executable_dir : str | None
+        Zero-compile cold start: a :class:`~sparkflow_tpu.serving.
+        coldstart.ExecutableStore` directory of ``jax.export``-serialized
+        executables. Warmup deserializes the bucket ladder from here
+        (sha256-verified) instead of compiling; anything missing or stale
+        compiles as usual — hitting ``compile_cache_dir`` when set — and
+        is saved back for the next boot.
     """
 
     def __init__(self, graph, weights=None, *,
@@ -108,6 +116,7 @@ class InferenceEngine:
                  compute_dtype=None,
                  warmup: bool = True,
                  compile_cache_dir: Optional[str] = None,
+                 executable_dir: Optional[str] = None,
                  metrics: Optional[metrics_mod.Metrics] = None):
         if isinstance(graph, str):
             from ..models import model_from_json
@@ -210,6 +219,30 @@ class InferenceEngine:
             from ..utils.hw import enable_compilation_cache
             self.compile_cache_dir = enable_compilation_cache(
                 compile_cache_dir)
+        # zero-compile cold start: warmup loads jax.export-serialized
+        # executables from here (sha256-manifested, ExecutableStore) before
+        # falling back to compiling (which may hit the compile cache above),
+        # and saves what it had to compile for the next boot
+        self.exec_store = None
+        self.serialized_loads = 0
+        self.serialized_saves = 0
+        self._exec_prefix = ""
+        if executable_dir is not None:
+            from .coldstart import ExecutableStore
+            self.exec_store = ExecutableStore(executable_dir,
+                                              metrics=self.metrics)
+            # key signature over every shape-determining knob: a store
+            # shared across differently-configured engines must never
+            # deserialize a wrong-shaped program
+            desc = repr((
+                self._in_shapes, [str(d) for d in self._in_dtypes],
+                self.quantize, self.output_name, self._in_keys,
+                dict(self.mesh.shape) if self.mesh is not None else None,
+                self.sharding.describe(),
+                [(tuple(s.shape), str(s.dtype))
+                 for s in jax.tree.leaves(self._weights_template)]))
+            sig = hashlib.sha256(desc.encode()).hexdigest()[:12]
+            self._exec_prefix = f"predict/{sig}"
         if warmup:
             self.warmup()
 
@@ -363,15 +396,30 @@ class InferenceEngine:
     def warmup(self) -> None:
         """AOT-compile every bucket. Idempotent; after it returns,
         ``predict`` never compiles for any request size."""
+        pending = []
         with self._compile_lock:
             before = self._cache_entries()
             compiled_now = 0
             for b in self.buckets:
                 if b not in self._compiled:
+                    # tier 1: deserialize a stored executable (no trace,
+                    # no XLA); tiers 2/3: compile (hitting the persistent
+                    # compile cache when configured), then store for the
+                    # next boot
+                    if self.exec_store is not None:
+                        exe = self.exec_store.load(
+                            f"{self._exec_prefix}/b{b}")
+                        if exe is not None:
+                            self._compiled[b] = exe
+                            self.serialized_loads += 1
+                            continue
                     with annotate(f"serving/aot_compile_b{b}"):
                         self._compiled[b] = self._compile_bucket(b)
                     self.aot_compiles += 1
                     compiled_now += 1
+                    if self.exec_store is not None:
+                        pending.append((f"{self._exec_prefix}/b{b}",
+                                        self._compiled[b]))
             if self.compile_cache_dir is not None and compiled_now:
                 # every compile either wrote a fresh cache entry (miss) or
                 # loaded an existing one (hit); the dir delta splits them
@@ -380,6 +428,14 @@ class InferenceEngine:
                 self.compile_cache_misses += misses
                 self.compile_cache_hits += compiled_now - misses
             self.recompile_guard.mark_steady()
+        # save-back AFTER the lock: ExecutableStore.save waits on the
+        # cross-process manifest lock, and that wait must not stall
+        # threads contending the compile lock (GC-L305)
+        saved = sum(1 for key, exe in pending
+                    if self.exec_store.save(key, exe))
+        if saved:
+            with self._compile_lock:
+                self.serialized_saves += saved
 
     def _executable(self, bucket: int):
         exe = self._compiled.get(bucket)
@@ -531,6 +587,11 @@ class InferenceEngine:
                     {"dir": self.compile_cache_dir,
                      "hits": self.compile_cache_hits,
                      "misses": self.compile_cache_misses}),
+                "cold_start": (
+                    None if self.exec_store is None else
+                    {"dir": self.exec_store.directory,
+                     "serialized_loads": self.serialized_loads,
+                     "serialized_saves": self.serialized_saves}),
                 "quantize": self.quantize,
                 "mesh": (dict(self.mesh.shape) if self.mesh is not None
                          else None),

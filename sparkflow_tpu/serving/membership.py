@@ -172,6 +172,12 @@ class Replica:
                                       recovery_s=recovery_s, clock=clock)
         # -- fields below are guarded by Membership._lock -------------------
         self.healthy = True          # optimistic until the first probe
+        # consecutive failed probes; reset by any green /healthz. The
+        # scaling policy's death debounce reads this: one missed probe
+        # takes the replica out of rotation (healthy=False) but does NOT
+        # mark it dead — probe timeouts correlate with saturation, and
+        # killing a slow replica amplifies the overload that slowed it.
+        self.probe_misses = 0
         self.inflight = 0            # router-side dispatches in flight
         self.queue_depth = 0         # replica-reported, from /healthz
         self.reported_in_flight = 0  # replica-reported, from /healthz
@@ -237,6 +243,11 @@ class Membership:
         if not urls:
             raise ValueError("at least one replica url is required")
         self.probe_interval_s = float(probe_interval_s)
+        # kept for register(): late-joining replicas get the same breaker
+        # and probe parameters the founding fleet got
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._failure_threshold = int(failure_threshold)
+        self._recovery_s = float(recovery_s)
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
         # version_policy: an object with filter_replicas(ordered, version_of)
         # — the router's CanaryController plugs in here to do version-aware
@@ -249,6 +260,7 @@ class Membership:
                     recovery_s=recovery_s, probe_timeout_s=probe_timeout_s,
                     clock=clock)
             for i, u in enumerate(urls)]
+        self._next_index = len(self._replicas)  # never-recycled identity
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
 
@@ -300,7 +312,10 @@ class Membership:
             was_healthy = replica.healthy
             replica.healthy = ok
             replica.last_probe_error = err
+            if not ok:
+                replica.probe_misses += 1
             if ok:
+                replica.probe_misses = 0
                 replica.last_probe_t = self._clock()
                 replica.queue_depth = int(body.get("queue_depth", 0))
                 replica.reported_in_flight = int(body.get("in_flight", 0))
@@ -370,7 +385,8 @@ class Membership:
             decode_free_slots=-1 if stale else replica.decode_free_slots,
             decode_pages_free=-1 if stale else replica.decode_pages_free,
             kv_bytes_per_page=replica.kv_bytes_per_page,
-            version=replica.version, dispatched=replica.dispatched)
+            version=replica.version, dispatched=replica.dispatched,
+            probe_misses=replica.probe_misses)
 
     def pick(self, exclude: Sequence[Replica] = (),
              signal: str = "predict") -> Optional[Replica]:
@@ -453,6 +469,44 @@ class Membership:
         logger.warning("router: ejected replica %s%s", replica.url,
                        f" ({reason})" if reason else "")
 
+    # -- elastic membership --------------------------------------------------
+
+    def register(self, url: str) -> Replica:
+        """Add a replica to the fleet at runtime (autoscaler scale-up /
+        crash replacement). The new record gets the next never-used index
+        — indices are identities in gauges and pick tie-breaks, so they
+        are not recycled — and is probed once synchronously so the very
+        next ``pick`` can route to it on real health."""
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+            replica = Replica(
+                url, idx, failure_threshold=self._failure_threshold,
+                recovery_s=self._recovery_s,
+                probe_timeout_s=self._probe_timeout_s, clock=self._clock)
+            self._replicas.append(replica)
+        self._probe_one(replica)
+        self.publish_gauges()
+        logger.info("router: registered replica %s as index %d", url, idx)
+        return replica
+
+    def deregister(self, replica: Replica) -> None:
+        """Remove a replica from the fleet for good (scale-down): filter
+        it from the pick order, stop probing it (the prober iterates the
+        live table), close its connections, and drop its
+        ``router/replica<i>/*`` gauges so the exposition doesn't advertise
+        a ghost replica forever — unlike :meth:`eject`, which keeps
+        probing so a restart re-admits."""
+        with self._lock:
+            try:
+                self._replicas.remove(replica)
+            except ValueError:
+                return                  # already gone: idempotent
+        replica.close()
+        self.metrics.remove_prefix(f"router/replica{replica.index}/")
+        logger.info("router: deregistered replica %s (index %d)",
+                    replica.url, replica.index)
+
     # -- introspection -------------------------------------------------------
 
     @property
@@ -465,6 +519,15 @@ class Membership:
             candidates = [r for r in self._replicas if r.healthy]
         return sum(1 for r in candidates
                    if r.breaker.state is not BreakerState.OPEN)
+
+    def views(self, now: Optional[float] = None) -> List[ReplicaView]:
+        """Frozen policy-layer snapshot of the whole fleet under one lock
+        acquisition — the autoscaler's input to
+        :func:`policies.scale_decision` (and the same shape the fleet
+        simulator feeds it, so sim-tuned bands transfer)."""
+        with self._lock:
+            t = self._clock() if now is None else now
+            return [self.view_of(r, t) for r in self._replicas]
 
     def snapshot(self) -> List[Dict[str, Any]]:
         """Per-replica status table for the router's ``/healthz`` body."""

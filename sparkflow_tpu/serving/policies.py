@@ -35,6 +35,13 @@ Decisions covered
 - :func:`probe_is_stale` — whether a replica's load report is too old to
   trust (its decision half lives here; reading the clock stays the
   caller's job).
+- :func:`scale_decision` / :func:`scale_down_order` — the elastic-fleet
+  control law: crash replacement first, then hysteresis-banded scale
+  up/down with cooldowns and min/max bounds. The live
+  :class:`~sparkflow_tpu.serving.autoscaler.Autoscaler` and the
+  simulator's ``SimAutoscaler`` hook run the SAME function, so the
+  policy is tuned against deterministic traffic steps before it ever
+  spawns a real process.
 """
 
 from __future__ import annotations
@@ -49,6 +56,9 @@ __all__ = [
     "predict_pick_key", "generate_pick_key", "pick_order",
     "classify_outcome", "canary_gate", "canary_reorder",
     "token_bucket_admit", "probe_is_stale", "percentile_nearest_rank",
+    "ScaleTargets", "AutoscalerState", "ScaleAction",
+    "SCALE_HOLD", "SCALE_UP", "SCALE_DOWN", "SCALE_REPLACE",
+    "scale_decision", "scale_down_order",
 ]
 
 
@@ -68,6 +78,18 @@ class ReplicaView:
     kv_bytes_per_page: int = -1
     version: int = -1
     dispatched: int = 0  # cumulative dispatches ever sent to this replica
+    # consecutive failed health probes (0 while probes pass). The scaling
+    # policy declares a replica dead only past ScaleTargets.dead_after_misses
+    # — a single miss is most likely the replica saturated, not gone.
+    # Definitive death evidence (exit-code reap, breaker OPEN) is overlaid
+    # by the autoscaler as misses >= the threshold.
+    probe_misses: int = 0
+    # does a supervisor own this replica's process? Unmanaged (founding-
+    # fleet) replicas can be routed around but never destroyed, drained,
+    # or deregistered by the autoscaler — there is no process handle to
+    # respawn, and a transient probe failure must not permanently evict
+    # a replica that would re-admit on recovery.
+    managed: bool = True
 
     @property
     def free_kv_bytes(self) -> int:
@@ -312,3 +334,198 @@ def probe_is_stale(last_probe_t: float, now: float,
     if last_probe_t <= 0.0:
         return False
     return (now - last_probe_t) > factor * probe_interval_s
+
+
+# -- elastic scaling ----------------------------------------------------------
+
+SCALE_HOLD = "hold"        # inside the band / cooling down: do nothing
+SCALE_UP = "up"            # queue wait above the high band: add replicas
+SCALE_DOWN = "down"        # queue wait below the low band: drain replicas
+SCALE_REPLACE = "replace"  # a replica died: respawn it, bypassing cooldowns
+
+
+@dataclass(frozen=True)
+class ScaleTargets:
+    """The autoscaler's tuning knobs — the full control law is a function
+    of these plus the observed fleet, so an A/B in the simulator is just
+    two ``ScaleTargets`` values replayed over the same trace.
+
+    The hysteresis band ``(queue_wait_low_ms, queue_wait_high_ms)`` is the
+    do-nothing region: scale up only above the high edge, down only below
+    the low edge. A single threshold oscillates — the capacity added at
+    the threshold drops queue wait just below it, which immediately votes
+    to scale down again; the band plus per-direction cooldowns is the
+    classic damping."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    queue_wait_high_ms: float = 200.0   # above: under-provisioned
+    queue_wait_low_ms: float = 50.0     # below: over-provisioned
+    up_cooldown_s: float = 10.0         # min gap between scale-ups
+    down_cooldown_s: float = 60.0       # min gap between scale-downs
+    max_step_up: int = 2                # replicas added per decision, cap
+    starved_fraction_up: float = 0.5    # fleet starvation scale-up trigger
+    # consecutive probe misses before an unhealthy view counts as DEAD
+    # (replace/refill) rather than SUSPECT (hold). Probe timeouts are most
+    # likely exactly when the replica is saturated, so acting on a single
+    # miss turns the autoscaler into a load-correlated failure amplifier —
+    # it would kill capacity during the overload that made the probe slow.
+    dead_after_misses: int = 3
+
+
+@dataclass(frozen=True)
+class AutoscalerState:
+    """What the control law remembers between decisions: the current
+    desired size and when it last moved in each direction (cooldowns are
+    judged against these, so a replacement — which doesn't change
+    ``desired`` — never resets them)."""
+
+    desired: int = 1
+    last_up_t: float = float("-inf")
+    last_down_t: float = float("-inf")
+
+
+@dataclass(frozen=True)
+class ScaleAction:
+    """One decision: ``kind`` is SCALE_HOLD/UP/DOWN/REPLACE, ``count`` how
+    many replicas to add (up/replace) or drain (down), ``targets`` the
+    view indices to act on (dead indices for replace, drain order for
+    down, empty for up — the supervisor picks ports), ``state`` the
+    successor :class:`AutoscalerState`, ``reason`` a human-readable why."""
+
+    kind: str
+    count: int = 0
+    targets: Tuple[int, ...] = ()
+    state: "AutoscalerState" = field(default_factory=lambda: AutoscalerState())
+    reason: str = ""
+
+
+def scale_down_order(views: Sequence[ReplicaView]) -> List[int]:
+    """Drain preference order for scale-down, best victim first: the
+    replica with zero in-flight generate slots drains free, a busy decode
+    replica drains last (its streams must finish before the process can
+    exit, holding the scale-down open). Ranks by (inflight, queue_depth,
+    -index) — the index tie-break prefers the HIGHEST index so a fleet
+    that scaled 0..n-1 up shrinks from the top, keeping the stable core
+    at low indices (and keeping the order deterministic for replay)."""
+    return [v.index for v in
+            sorted(views, key=lambda v: (v.inflight, v.queue_depth,
+                                         -v.index))]
+
+
+def scale_decision(views: Sequence[ReplicaView], targets: ScaleTargets,
+                   state: AutoscalerState, now: float, *,
+                   queue_wait_p95_ms: Optional[float] = None) -> ScaleAction:
+    """One tick of the elastic-fleet control law. Priority order is the
+    contract (pinned by the fake-clock units in ``tests/test_autoscaler.py``):
+
+    1. **Crash replacement** — DEAD managed views are respawned
+       immediately, bypassing both cooldowns and (if the fleet is at max)
+       the size check: a replacement restores capacity the fleet already
+       decided it needs, it is not growth. Dead means *debounced* dead:
+       ``probe_misses >= targets.dead_after_misses`` (the autoscaler
+       overlays definitive evidence — exit-code reap, breaker OPEN — as
+       misses past the threshold). An unhealthy view below the threshold
+       is a SUSPECT: it still counts as capacity and nothing is killed —
+       a probe timeout is most likely the replica saturated, and killing
+       it would amplify the very overload that slowed the probe.
+       Unmanaged views are NEVER replace targets (no process handle to
+       respawn; a recovered probe re-admits them); one past the threshold
+       simply stops counting as capacity, so the below-min rule refills
+       the fleet with fresh managed replicas around it.
+    2. **Below-min catch-up** — fewer presumed-alive replicas (healthy +
+       suspects) than ``min_replicas`` scales up without cooldown (the
+       floor is a hard bound, not a preference).
+    3. **Scale up** — queue-wait p95 above the high band edge, or a
+       ``starved_fraction_up`` share of the live fleet starved (zero free
+       decode slots/pages), adds ``ceil``-style capacity: one replica per
+       full band-multiple of overshoot, capped at ``max_step_up`` and
+       ``max_replicas``, gated on ``up_cooldown_s``.
+    4. **Scale down** — queue-wait p95 below the low band edge (and no
+       starvation) drains ONE replica per decision — the
+       :func:`scale_down_order` victim among MANAGED live views (an
+       unmanaged replica cannot be drained, and electing one would burn
+       the down-cooldown on a no-op) — gated on ``down_cooldown_s`` since
+       the last move in EITHER direction (shrinking right after growing
+       is the oscillation the band exists to prevent), floored at
+       ``min_replicas``.
+    5. **Hold** otherwise.
+
+    ``queue_wait_p95_ms`` is None when the histogram has no samples yet
+    (idle fleet): treated as 0 for the down path so an idle oversized
+    fleet does shrink, and as no-signal for the up path."""
+    threshold = max(1, targets.dead_after_misses)
+    live = [v for v in views if v.healthy]
+    dead = tuple(v.index for v in views
+                 if v.managed and not v.healthy
+                 and v.probe_misses >= threshold)
+    # unhealthy but under the miss threshold (either ownership): presumed
+    # returning, counts as capacity, never acted on this tick
+    suspects = [v for v in views
+                if not v.healthy and v.probe_misses < threshold]
+    fleet = len(live) + len(suspects)
+
+    if dead:
+        return ScaleAction(SCALE_REPLACE, count=len(dead), targets=dead,
+                           state=state,
+                           reason=f"{len(dead)} replica(s) down")
+
+    if fleet < targets.min_replicas:
+        n = targets.min_replicas - fleet
+        return ScaleAction(
+            SCALE_UP, count=n,
+            state=AutoscalerState(desired=fleet + n,
+                                  last_up_t=now,
+                                  last_down_t=state.last_down_t),
+            reason=f"below min_replicas ({fleet} < "
+                   f"{targets.min_replicas})")
+
+    starved = sum(1 for v in live
+                  if v.decode_free_slots == 0 or v.decode_pages_free == 0)
+    fleet_starved = (len(live) > 0 and
+                     starved >= targets.starved_fraction_up * len(live))
+    wait = queue_wait_p95_ms
+    overloaded = (wait is not None and wait > targets.queue_wait_high_ms)
+
+    if (overloaded or fleet_starved) and fleet < targets.max_replicas:
+        if now - state.last_up_t < targets.up_cooldown_s:
+            return ScaleAction(SCALE_HOLD, state=state,
+                               reason="up-cooldown")
+        if overloaded:
+            # one replica per full band-width of overshoot: a 2x step in
+            # queue wait asks for proportionally more capacity than a 5%
+            # drift over the edge, without a model of service rate
+            band = max(targets.queue_wait_high_ms, 1e-9)
+            step = 1 + int((wait - targets.queue_wait_high_ms) / band)
+        else:
+            step = 1
+        step = min(step, targets.max_step_up,
+                   targets.max_replicas - fleet)
+        why = (f"queue wait p95 {wait:.0f}ms > "
+               f"{targets.queue_wait_high_ms:.0f}ms" if overloaded
+               else f"{starved}/{len(live)} replicas starved")
+        return ScaleAction(
+            SCALE_UP, count=step,
+            state=AutoscalerState(desired=fleet + step,
+                                  last_up_t=now,
+                                  last_down_t=state.last_down_t),
+            reason=why)
+
+    idle_wait = wait if wait is not None else 0.0
+    candidates = [v for v in live if v.managed]
+    if (idle_wait < targets.queue_wait_low_ms and not fleet_starved
+            and len(live) > targets.min_replicas and candidates):
+        ref = max(state.last_down_t, state.last_up_t)
+        if now - ref < targets.down_cooldown_s:
+            return ScaleAction(SCALE_HOLD, state=state,
+                               reason="down-cooldown")
+        victim = scale_down_order(candidates)[0]
+        return ScaleAction(
+            SCALE_DOWN, count=1, targets=(victim,),
+            state=AutoscalerState(desired=len(live) - 1,
+                                  last_up_t=state.last_up_t,
+                                  last_down_t=now),
+            reason=f"queue wait p95 {idle_wait:.0f}ms < "
+                   f"{targets.queue_wait_low_ms:.0f}ms")
+
+    return ScaleAction(SCALE_HOLD, state=state, reason="in band")

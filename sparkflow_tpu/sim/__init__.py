@@ -21,14 +21,14 @@ what-if end to end; ``bench.py --sim`` records scale + calibration
 numbers in ``BENCH_NOTES.md``.
 """
 
-from .core import (FleetSimulator, ReplicaSpec, SimReplica, SimReport,
-                   legacy_generate_pick_key)
+from .core import (FleetSimulator, ReplicaSpec, SimAutoscaler, SimReplica,
+                   SimReport, legacy_generate_pick_key)
 from .costmodel import CostModel
 from .trace import Request, load, save, synthetic_trace
 
 # NOTE: `calibrate` is deliberately NOT imported here — it pulls the full
 # serving stack (and through it JAX); `from sparkflow_tpu.sim import
 # calibrate` loads it on demand. Pure-sim runs stay import-light.
-__all__ = ["FleetSimulator", "ReplicaSpec", "SimReplica", "SimReport",
-           "legacy_generate_pick_key", "CostModel", "Request",
+__all__ = ["FleetSimulator", "ReplicaSpec", "SimAutoscaler", "SimReplica",
+           "SimReport", "legacy_generate_pick_key", "CostModel", "Request",
            "synthetic_trace", "save", "load"]

@@ -45,7 +45,7 @@ import hashlib
 import heapq
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.retry import RetryPolicy
@@ -55,10 +55,10 @@ from ..serving.policies import ReplicaView
 from ..serving.router import CanaryController, TokenBucket
 
 __all__ = ["ReplicaSpec", "SimReplica", "SimReport", "FleetSimulator",
-           "legacy_generate_pick_key"]
+           "SimAutoscaler", "legacy_generate_pick_key"]
 
 # event kinds (ints: compared only via the heap's (t, seq) prefix)
-_ARRIVE, _PROBE, _FINISH, _RETRY, _CHAOS = 0, 1, 2, 3, 4
+_ARRIVE, _PROBE, _FINISH, _RETRY, _CHAOS, _SCALE, _SPAWN = range(7)
 
 
 def legacy_generate_pick_key(view: ReplicaView) -> Tuple:
@@ -78,6 +78,30 @@ def legacy_generate_pick_key(view: ReplicaView) -> Tuple:
 
 
 @dataclass(frozen=True)
+class SimAutoscaler:
+    """Elastic-fleet hook for :class:`FleetSimulator`: runs the REAL
+    :func:`sparkflow_tpu.serving.policies.scale_decision` on the virtual
+    clock, so a :class:`~sparkflow_tpu.serving.policies.ScaleTargets`
+    candidate is A/B-tuned against deterministic traffic steps before the
+    live :class:`~sparkflow_tpu.serving.autoscaler.Autoscaler` ever spawns
+    a process.
+
+    ``specs`` passed to the simulator describe the *physical pool* (the
+    machines the fleet could occupy); ``initial`` of them start live and
+    ``targets.max_replicas`` bounds growth. ``spawn_delay_s`` models
+    boot-to-serving time — the quantity the zero-compile cold start
+    attacks, and exactly what makes a sluggish policy visible: capacity
+    ordered at the band edge arrives ``spawn_delay_s`` late."""
+
+    targets: policies.ScaleTargets = field(
+        default_factory=policies.ScaleTargets)
+    initial: int = 1
+    decide_interval_s: float = 1.0
+    spawn_delay_s: float = 2.0
+    queue_wait_window: int = 256   # samples in the rolling p95 window
+
+
+@dataclass(frozen=True)
 class ReplicaSpec:
     """Static description of one simulated replica."""
 
@@ -91,12 +115,12 @@ class ReplicaSpec:
 class SimReplica:
     """Mutable per-replica simulation state (truth + last probe report)."""
 
-    __slots__ = ("index", "spec", "up", "probe_healthy", "inflight",
-                 "active", "pages_free", "queue", "running", "epoch",
-                 "reported_queue_depth", "reported_free_slots",
+    __slots__ = ("index", "spec", "up", "probe_healthy", "probe_misses",
+                 "inflight", "active", "pages_free", "queue", "running",
+                 "epoch", "reported_queue_depth", "reported_free_slots",
                  "reported_pages_free", "last_probe_t", "dispatched",
                  "completed", "busy_s", "breaker", "version",
-                 "_breaker_state")
+                 "_breaker_state", "in_fleet", "draining")
 
     def __init__(self, index: int, spec: ReplicaSpec,
                  clock: Callable[[], float],
@@ -105,6 +129,7 @@ class SimReplica:
         self.spec = spec
         self.up = True                 # chaos truth
         self.probe_healthy = True      # router's belief
+        self.probe_misses = 0          # consecutive failed probes
         self.inflight = 0              # router-side live counter
         self.active = 0                # lanes busy (replica truth)
         self.pages_free = spec.pages_total
@@ -119,6 +144,8 @@ class SimReplica:
         self.completed = 0
         self.busy_s = 0.0
         self.version = spec.version
+        self.in_fleet = True           # registered with the router
+        self.draining = False          # scale-down in progress
         self.breaker = CircuitBreaker(failure_threshold=failure_threshold,
                                       recovery_s=recovery_s, clock=clock)
         self._breaker_state = BreakerState.CLOSED
@@ -136,7 +163,8 @@ class SimReplica:
             decode_free_slots=self.reported_free_slots,
             decode_pages_free=self.reported_pages_free,
             kv_bytes_per_page=self.spec.kv_bytes_per_page,
-            version=self.version, dispatched=self.dispatched)
+            version=self.version, dispatched=self.dispatched,
+            probe_misses=self.probe_misses)
 
 
 @dataclass
@@ -154,6 +182,10 @@ class SimReport:
     breaker_transitions: int = 0
     canary_promotions: int = 0
     canary_rollbacks: int = 0
+    scale_ups: int = 0          # scale-up decisions taken
+    scale_downs: int = 0        # scale-down decisions taken
+    replacements: int = 0       # crashed replicas respawned
+    final_fleet_size: int = 0   # live replicas when the run ended
     sim_time_s: float = 0.0
     wall_s: float = 0.0
     ttft_p50_ms: float = 0.0
@@ -172,7 +204,9 @@ class SimReport:
             "requests", "completed", "rejected", "failed_dispatches",
             "reroutes", "queue_full", "admission_rejects",
             "breaker_transitions", "canary_promotions",
-            "canary_rollbacks", "sim_time_s", "wall_s", "ttft_p50_ms",
+            "canary_rollbacks", "scale_ups", "scale_downs",
+            "replacements", "final_fleet_size",
+            "sim_time_s", "wall_s", "ttft_p50_ms",
             "ttft_p95_ms", "latency_p50_ms", "latency_p95_ms",
             "throughput_rps", "digest")}
         d["per_replica"] = self.per_replica
@@ -217,6 +251,7 @@ class FleetSimulator:
                  canary: bool = False,
                  canary_kwargs: Optional[Dict[str, Any]] = None,
                  chaos: Sequence[Tuple] = (),
+                 autoscaler: Optional[SimAutoscaler] = None,
                  max_attempts: int = 5,
                  failure_threshold: int = 3, recovery_s: float = 2.0,
                  record_events: bool = False):
@@ -254,6 +289,21 @@ class FleetSimulator:
                                  sleep=lambda _s: None)
         self.chaos = sorted(chaos, key=lambda c: (c[0], c[1]))
         self.record_events = record_events
+        # elastic-fleet hook: specs are the physical pool; replicas past
+        # ``initial`` start deactivated and the real scale_decision (on
+        # the virtual clock) activates/drains them
+        self.autoscaler = autoscaler
+        self._scale_state = policies.AutoscalerState(
+            desired=autoscaler.initial if autoscaler else len(self.replicas))
+        self._pending_spawn: set = set()
+        self._wait_samples: deque = deque(
+            maxlen=autoscaler.queue_wait_window if autoscaler else 256)
+        if autoscaler is not None:
+            if not 0 < autoscaler.initial <= len(self.replicas):
+                raise ValueError("autoscaler.initial must be within the "
+                                 "physical pool size")
+            for r in self.replicas[autoscaler.initial:]:
+                r.in_fleet = False
         # per-request mutable state
         n = len(self.trace)
         self._attempts = [0] * n
@@ -268,6 +318,7 @@ class FleetSimulator:
         # lazy pick heap: (key, index, stamp); stale stamps are skipped
         self._pick_heap: List[Tuple] = []
         self._stamp = [0] * len(self.replicas)
+        self._probe_live = [False] * len(self.replicas)
         self.report = SimReport(requests=n)
 
     # -- event plumbing ----------------------------------------------------
@@ -295,7 +346,7 @@ class FleetSimulator:
         """Refresh one replica's pick-heap entry (its key changed)."""
         i = r.index
         self._stamp[i] += 1
-        if r.probe_healthy:
+        if r.probe_healthy and r.in_fleet:
             heapq.heappush(self._pick_heap,
                            (self._pick_key(r.view()), i, self._stamp[i]))
 
@@ -313,8 +364,8 @@ class FleetSimulator:
             entry = heap[0]
             key, i, stm = entry
             r = self.replicas[i]
-            if stm != stamp[i] or not r.probe_healthy:
-                heapq.heappop(heap)      # stale or dead entry
+            if stm != stamp[i] or not r.probe_healthy or not r.in_fleet:
+                heapq.heappop(heap)      # stale, dead, or drained entry
                 continue
             if i in exclude:
                 setaside.append(heapq.heappop(heap))
@@ -332,7 +383,8 @@ class FleetSimulator:
     def _pick_full_sort(self, exclude: frozenset) -> Optional[SimReplica]:
         """The real router's exact path: full policy sort + canary
         filter + breaker walk. Used when canary routing is on."""
-        cand = [r for r in self.replicas if r.index not in exclude]
+        cand = [r for r in self.replicas
+                if r.in_fleet and r.index not in exclude]
         views = [r.view() for r in cand]
         if self._custom_key:
             order = [v.index for v in sorted(
@@ -424,6 +476,9 @@ class FleetSimulator:
 
     def _start(self, rid: int, req, r: SimReplica) -> None:
         """Begin service on a free lane; schedules the finish event."""
+        # queue-wait sample: arrival -> service start, the autoscaler's
+        # overload signal (covers replica queueing AND client retries)
+        self._wait_samples.append((self._now - req.arrival_s) * 1e3)
         before = r.active
         r.active += 1
         speed = r.spec.speed
@@ -464,15 +519,24 @@ class FleetSimulator:
         if r.queue:
             nxt = r.queue.popleft()
             self._start(nxt, self.trace[nxt], r)
+        if r.draining and r.active == 0 and not r.queue:
+            r.draining = False
+            self._log(f"scale_down_complete r{idx}")
         self._reindex(r)
 
     # -- probes and chaos --------------------------------------------------
 
     def _probe(self, idx: int) -> None:
         r = self.replicas[idx]
+        if not r.in_fleet:
+            # deregistered (drained): the probe chain dies; a respawn
+            # restarts it — mirrors Membership.deregister cancelling probes
+            self._probe_live[idx] = False
+            return
         if r.up:
             was = r.probe_healthy
             r.probe_healthy = True
+            r.probe_misses = 0
             r.reported_queue_depth = len(r.queue)
             r.reported_free_slots = max(0, r.spec.slots - r.active)
             r.reported_pages_free = r.pages_free
@@ -484,6 +548,7 @@ class FleetSimulator:
             if r.probe_healthy:
                 self._log(f"probe_fail r{idx}")
             r.probe_healthy = False
+            r.probe_misses += 1
             self._stamp[idx] += 1       # drop its pick-heap entry
         self._push(self._now + self.probe_interval_s, _PROBE, idx)
 
@@ -523,6 +588,104 @@ class FleetSimulator:
         else:
             raise ValueError(f"unknown chaos action {action!r}")
 
+    # -- elastic scaling ---------------------------------------------------
+
+    def _scale_tick(self) -> None:
+        """One autoscaler decision on the virtual clock: build views of
+        the registered fleet, run the REAL ``policies.scale_decision``,
+        apply the action. Mirrors ``Autoscaler.tick``'s overlays: a
+        breaker-OPEN replica is dead to the policy past the probe-miss
+        debounce (detection at request cadence, not probe cadence), and a
+        spawn already in flight counts as live-but-booting capacity — the
+        real autoscaler spawns synchronously inside its tick, so without
+        the synthetic view every tick during ``spawn_delay_s`` would
+        re-order the same deficit and overshoot the target."""
+        a = self.autoscaler
+        views = []
+        for r in self.replicas:
+            if not r.in_fleet or r.index in self._pending_spawn:
+                continue
+            v = r.view()
+            if r.breaker.state is BreakerState.OPEN:
+                v = replace(v, healthy=False,
+                            probe_misses=max(v.probe_misses,
+                                             a.targets.dead_after_misses))
+            views.append(v)
+        for i in sorted(self._pending_spawn):
+            spec = self.replicas[i].spec
+            views.append(ReplicaView(
+                index=i, healthy=True,
+                decode_free_slots=spec.slots,
+                decode_pages_free=spec.pages_total,
+                kv_bytes_per_page=spec.kv_bytes_per_page))
+        wait = (policies.percentile_nearest_rank(
+                    list(self._wait_samples), 95.0)
+                if self._wait_samples else None)
+        action = policies.scale_decision(views, a.targets,
+                                         self._scale_state, self._now,
+                                         queue_wait_p95_ms=wait)
+        self._scale_state = action.state
+        if action.kind == policies.SCALE_REPLACE:
+            for idx in action.targets:
+                if idx in self._pending_spawn:
+                    continue
+                self._pending_spawn.add(idx)
+                self.report.replacements += 1
+                self._log(f"scale replace r{idx} ({action.reason})")
+                self._push(self._now + a.spawn_delay_s, _SPAWN, idx)
+        elif action.kind == policies.SCALE_UP:
+            spare = [r.index for r in self.replicas
+                     if not r.in_fleet and not r.draining
+                     and r.index not in self._pending_spawn]
+            took = spare[:action.count]
+            if took:
+                self.report.scale_ups += 1
+                self._log(f"scale up +{len(took)} {took} "
+                          f"({action.reason})")
+            for idx in took:
+                self._pending_spawn.add(idx)
+                self._push(self._now + a.spawn_delay_s, _SPAWN, idx)
+        elif action.kind == policies.SCALE_DOWN:
+            self.report.scale_downs += 1
+            for idx in action.targets:
+                r = self.replicas[idx]
+                r.in_fleet = False       # deregister: out of the pick now
+                r.draining = r.active > 0 or bool(r.queue)
+                self._stamp[idx] += 1    # drop its pick-heap entry
+                self._log(f"scale down r{idx} draining={r.draining} "
+                          f"({action.reason})")
+        self._push(self._now + a.decide_interval_s, _SCALE)
+
+    def _spawned(self, idx: int) -> None:
+        """Spawn complete after ``spawn_delay_s``: the replica boots (or
+        reboots, for a crash replacement) into a clean serving state and
+        registers with the fleet."""
+        r = self.replicas[idx]
+        self._pending_spawn.discard(idx)
+        r.up = True
+        r.in_fleet = True
+        r.draining = False
+        r.probe_healthy = True
+        r.probe_misses = 0
+        # a replacement is a NEW process in production: its breaker starts
+        # CLOSED, so the respawned slot must not stay dead to the policy
+        r.breaker.record_success()
+        self._note_breaker(r)
+        r.active = 0
+        r.inflight = 0
+        r.queue.clear()
+        r.running.clear()
+        r.pages_free = r.spec.pages_total
+        r.reported_queue_depth = 0
+        r.reported_free_slots = r.spec.slots
+        r.reported_pages_free = r.spec.pages_total
+        r.last_probe_t = self._now
+        self._log(f"spawned r{idx}")
+        self._reindex(r)
+        if not self._probe_live[idx]:
+            self._probe_live[idx] = True
+            self._push(self._now + self.probe_interval_s, _PROBE, idx)
+
     # -- run ---------------------------------------------------------------
 
     def run(self) -> SimReport:
@@ -531,9 +694,14 @@ class FleetSimulator:
         # refresh in lockstep (mirrors independent probe loops)
         nrep = len(self.replicas)
         for r in self.replicas:
+            if not r.in_fleet:
+                continue                 # deactivated pool slot
             self._reindex(r)
+            self._probe_live[r.index] = True
             self._push((r.index + 1) * self.probe_interval_s / (nrep + 1),
                        _PROBE, r.index)
+        if self.autoscaler is not None:
+            self._push(self.autoscaler.decide_interval_s, _SCALE)
         for rid, req in enumerate(self.trace):
             self._push(req.arrival_s, _ARRIVE, rid)
         for t, idx, action in self.chaos:
@@ -553,6 +721,10 @@ class FleetSimulator:
                 self._probe(a)
             elif kind == _CHAOS:
                 self._chaos(a, b)
+            elif kind == _SCALE:
+                self._scale_tick()
+            elif kind == _SPAWN:
+                self._spawned(a)
         self._finalize(time.monotonic() - wall0)
         return self.report
 
@@ -560,6 +732,7 @@ class FleetSimulator:
         rep = self.report
         rep.sim_time_s = self._now
         rep.wall_s = wall_s
+        rep.final_fleet_size = sum(1 for r in self.replicas if r.in_fleet)
         lat = sorted(rep.latencies_ms)
         ttft = sorted(rep.ttfts_ms)
         rep.latency_p50_ms = policies.percentile_nearest_rank(lat, 50.0)

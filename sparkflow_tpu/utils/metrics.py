@@ -30,7 +30,7 @@ import json
 import random
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 # Per-histogram sample cap. Beyond it, reservoir sampling keeps a uniform
@@ -38,14 +38,27 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 # unbounded append a months-long serving process would otherwise pay for.
 HISTOGRAM_RESERVOIR = 4096
 
+# How many of the MOST RECENT observations each histogram also retains,
+# in insertion order, for windowed percentiles. The reservoir above is a
+# uniform sample of the whole stream — slicing its tail has no recency
+# bias at all (overwrites land at random positions), so a "windowed"
+# read off it would ossify: a past overload burst stays in the signal
+# forever and a new one barely registers once the stream is long. The
+# deque is the true sliding window; window= reads larger than this cap
+# are clamped to it.
+HISTOGRAM_WINDOW = 1024
+
 
 class _Histogram:
-    """Reservoir-sampled value distribution with exact count/min/max/sum."""
+    """Reservoir-sampled value distribution with exact count/min/max/sum
+    plus a bounded insertion-ordered tail for windowed percentiles."""
 
-    __slots__ = ("samples", "count", "total", "vmin", "vmax", "_rng")
+    __slots__ = ("samples", "recent", "count", "total", "vmin", "vmax",
+                 "_rng")
 
     def __init__(self, seed: int = 0):
         self.samples: List[float] = []
+        self.recent: deque = deque(maxlen=HISTOGRAM_WINDOW)
         self.count = 0
         self.total = 0.0
         self.vmin = float("inf")
@@ -57,6 +70,7 @@ class _Histogram:
         self.total += value
         self.vmin = min(self.vmin, value)
         self.vmax = max(self.vmax, value)
+        self.recent.append(value)
         if len(self.samples) < HISTOGRAM_RESERVOIR:
             self.samples.append(value)
         else:
@@ -64,12 +78,20 @@ class _Histogram:
             if j < HISTOGRAM_RESERVOIR:
                 self.samples[j] = value
 
-    def percentile(self, q: float) -> float:
-        """Linear-interpolated q-th percentile (q in [0, 100]) of the
-        reservoir sample."""
+    def percentile(self, q: float, window: Optional[int] = None) -> float:
+        """Linear-interpolated q-th percentile (q in [0, 100]).
+
+        Without ``window``: over the whole-stream reservoir sample. With
+        ``window``: over exactly the last ``window`` observations (clamped
+        to ``HISTOGRAM_WINDOW``) from the insertion-ordered tail — a true
+        sliding window, so the autoscaler's p95 tracks what the fleet did
+        in the last N requests, not a uniform sample of its whole life."""
         if not self.samples:
             raise ValueError("empty histogram")
-        s = sorted(self.samples)
+        if window is None or int(window) <= 0:
+            s = sorted(self.samples)
+        else:
+            s = sorted(list(self.recent)[-int(window):])
         if len(s) == 1:
             return s[0]
         pos = (q / 100.0) * (len(s) - 1)
@@ -130,12 +152,15 @@ class Metrics:
                 h = self._hists[name] = _Histogram(seed=len(self._hists))
             h.add(float(value))
 
-    def percentile(self, name: str, q: float) -> float:
-        """q-th percentile (q in [0, 100]) of histogram ``name``."""
+    def percentile(self, name: str, q: float,
+                   window: Optional[int] = None) -> float:
+        """q-th percentile (q in [0, 100]) of histogram ``name``;
+        ``window`` = only the most recent samples (see
+        :meth:`_Histogram.percentile`)."""
         with self._lock:
             if name not in self._hists:
                 raise KeyError(f"no histogram named {name!r}")
-            return self._hists[name].percentile(q)
+            return self._hists[name].percentile(q, window)
 
     def percentiles(self, name: str,
                     qs: Sequence[float] = (50, 95, 99)) -> Dict[str, float]:
@@ -201,6 +226,21 @@ class Metrics:
                                     "ts": ts}) + "\n")
             for name, hist in hists.items():
                 f.write(json.dumps({"name": name, "histogram": hist}) + "\n")
+
+    def remove_prefix(self, prefix: str) -> int:
+        """Drop every series whose name starts with ``prefix`` (all four
+        tables); returns how many were removed. This is the deregistration
+        path: a replica leaving the fleet must take its
+        ``router/replica<i>/*`` gauges with it, or the exposition keeps
+        advertising a ghost replica forever."""
+        removed = 0
+        with self._lock:
+            for table in (self._scalars, self._counters, self._gauges,
+                          self._hists):
+                for name in [n for n in table if n.startswith(prefix)]:
+                    del table[name]
+                    removed += 1
+        return removed
 
     def reset(self) -> None:
         with self._lock:

@@ -198,6 +198,26 @@ def test_gauge_last_value_wins_and_exports():
     assert "mem_dev0_bytes_in_use 250.0" in text
 
 
+def test_histogram_window_is_a_true_sliding_window():
+    # the reservoir is a uniform whole-stream sample: past the cap, new
+    # values land at random positions, so slicing its tail has no recency
+    # bias. The windowed percentile must read the insertion-ordered tail
+    # instead — a long-past overload burst must NOT pin a "recent" p95
+    # high forever (that would block scale-down on any long-lived fleet).
+    m = Metrics()
+    for _ in range(6000):          # overload burst, well past the cap
+        m.observe("router/request_ms", 1000.0)
+    for _ in range(300):           # traffic calmed down
+        m.observe("router/request_ms", 10.0)
+    assert m.percentile("router/request_ms", 95, window=256) == 10.0
+    # the whole-life percentile still reflects the full stream
+    assert m.percentile("router/request_ms", 95) > 500.0
+    # and a fresh overload registers immediately in the window
+    for _ in range(300):
+        m.observe("router/request_ms", 2000.0)
+    assert m.percentile("router/request_ms", 95, window=256) == 2000.0
+
+
 def test_gauge_in_jsonl_dump(tmp_path):
     m = Metrics()
     m.gauge("g", 1.5)
@@ -365,6 +385,38 @@ def test_fleet_model_parallel_gauges_prometheus_exposition():
         assert "router_replica0_kv_pages_free 16.0" in text
     finally:
         mem.stop()
+
+
+def test_autoscaler_gauges_prometheus_exposition():
+    """The elastic-fleet controller's gauges (``autoscaler/{replicas,
+    target,spawns,drains,replacements,last_decision}``) land in the
+    Prometheus text with sanitized names, and a deregistered replica's
+    ``router/replica<i>/*`` family disappears from the exposition."""
+    from sparkflow_tpu.serving.autoscaler import Autoscaler, ReplicaManager
+    from sparkflow_tpu.serving.membership import Membership
+
+    m = Metrics()
+    mem = Membership(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                     metrics=m)
+    for r in mem.replicas:
+        r.healthy = True
+    mem.publish_gauges()
+    rm = ReplicaManager(lambda port: None, membership=mem, metrics=m)
+    a = Autoscaler(mem, rm, metrics=m, queue_wait_signal=lambda: None)
+    a.publish_gauges()
+    text = prometheus_text(m)
+    for fam in ("autoscaler_replicas", "autoscaler_target",
+                "autoscaler_spawns", "autoscaler_drains",
+                "autoscaler_replacements", "autoscaler_last_decision"):
+        assert f"# TYPE {fam} gauge" in text, fam
+    assert "autoscaler_replicas 2.0" in text
+    assert "autoscaler_last_decision 0.0" in text  # hold
+    assert "router_replica0_healthy" in text
+    # scale-down removes the ghost's whole family from the exposition
+    mem.deregister(mem.replicas[0])
+    text = prometheus_text(m)
+    assert "router_replica0_" not in text
+    assert "router_replica1_healthy" in text
 
 
 def test_kv_quant_gauges_prometheus_exposition():
