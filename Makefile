@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke scale-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft lint-graft-strict obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke scale-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -135,6 +135,13 @@ zero-smoke:
 # source + the jaxpr self-check over presets x optimizers (docs/analysis.md)
 lint-graft:
 	JAX_PLATFORMS=cpu python -m sparkflow_tpu.analysis sparkflow_tpu examples
+
+# the CI gate flavor: the same full pass (all GC families, including the
+# GC-X6xx resource-lifecycle rules), exits nonzero on ANY finding — this
+# is what tests/test_lint_gate.py pins as a tier-1 test
+lint-graft-strict:
+	JAX_PLATFORMS=cpu python -m sparkflow_tpu.analysis sparkflow_tpu examples --format json
+	@echo "lint-graft-strict: clean"
 
 # dynamic race smoke: the decode drain-under-load chaos scenario run
 # entirely under the Eraser lockset detector (GC-R402) — zero empty-lockset

@@ -107,7 +107,7 @@ def wait_healthy(url: str, timeout_s: float = 60.0) -> None:
 
 
 def main() -> None:
-    from sparkflow_tpu.analysis import racecheck
+    from sparkflow_tpu.analysis import racecheck, restrack
 
     ports = free_ports(N_REPLICAS)
     urls = [f"http://127.0.0.1:{p}" for p in ports]
@@ -119,6 +119,13 @@ def main() -> None:
     # the router's shared state fails the smoke with both access stacks
     tracker = racecheck.RaceTracker().install() if racecheck.enabled() \
         else None
+    # SPARKFLOW_TPU_RESTRACK=1 additionally audits resource balance: every
+    # pooled-connection checkout must be returned and every
+    # router/replica<i>/* gauge family must leave the registry with its
+    # replica (deregister or stop), or the smoke fails with the stacks
+    retracker = restrack.ResourceTracker().install() \
+        if restrack.enabled() else None
+    clean = False
     try:
         for u in urls:
             wait_healthy(u)
@@ -141,6 +148,11 @@ def main() -> None:
                 racecheck.instrument_object(
                     router.cache, fields=("hits", "misses"),
                     name="ResultCache")
+        if retracker is not None:  # before start(), like racecheck
+            restrack.instrument_metrics(router.metrics,
+                                        prefixes=("router/replica",))
+            for rep in router.membership._replicas:
+                restrack.instrument_pool(rep.pool)
         router.start()
         print(f"router up on {router.url} fronting {N_REPLICAS} replicas",
               flush=True)
@@ -204,11 +216,22 @@ def main() -> None:
               f"failures through kill+restart "
               f"(rerouted={counters.get('router/rerouted', 0):.0f}, "
               f"healthy_replicas={health['healthy_replicas']})", flush=True)
+        clean = True
     finally:
         if tracker is not None:
             tracker.uninstall()
         if router is not None:
             router.stop()
+        # balance is only meaningful after router.stop() took the replica
+        # gauges down; skip the assert when the smoke already failed so the
+        # original error isn't shadowed by the leaks it caused
+        if retracker is not None:
+            retracker.uninstall()
+            if clean:
+                retracker.assert_balanced()
+                print(f"restrack: zero unbalanced resources "
+                      f"({retracker.acquired} acquired, "
+                      f"{retracker.released} released)", flush=True)
         for proc in procs.values():
             if proc.poll() is None:
                 proc.terminate()

@@ -35,7 +35,7 @@ ensure_live_backend()
 
 import jax
 
-from sparkflow_tpu.analysis import racecheck
+from sparkflow_tpu.analysis import racecheck, restrack
 from sparkflow_tpu.models.registry import build_registry_spec, model_from_json
 from sparkflow_tpu.serving import ContinuousBatcher, DecodeEngine, Draining
 
@@ -68,7 +68,16 @@ def main() -> None:
                            "_tokens_saved"),
         name="PagedKVCache")
     racecheck.instrument_object(engine.metrics, name="Metrics")
+    # SPARKFLOW_TPU_RESTRACK=1 additionally audits resource balance: every
+    # decode slot prefill() checks out must come back through release() by
+    # the end of the drain, or the leak's acquisition stack fails the smoke
+    retracker = restrack.ResourceTracker().install() \
+        if restrack.enabled() else None
+    if retracker is not None:
+        restrack.instrument_engine(engine)
     batcher = ContinuousBatcher(engine, max_queue=64)
+    if retracker is not None:
+        restrack.instrument_batcher(batcher)
 
     futures, refused = [], []
     fut_mu = threading.Lock()
@@ -111,10 +120,18 @@ def main() -> None:
         assert out["num_tokens"] == len(out["tokens"]) > 0, out
 
     tracker.assert_clean()
+    restrack_note = ""
+    if retracker is not None:
+        retracker.uninstall()
+        retracker.assert_balanced()
+        restrack_note = (f" and zero unbalanced resources "
+                         f"({retracker.acquired} acquired, "
+                         f"{retracker.released} released)")
     print(f"race-smoke OK: {len(futures)} generations "
           f"({len(refused)} refused post-drain) through drain-under-load "
           f"with zero empty-lockset reports over "
-          f"{len(tracker._fields)} tracked fields", flush=True)
+          f"{len(tracker._fields)} tracked fields{restrack_note}",
+          flush=True)
 
 
 if __name__ == "__main__":

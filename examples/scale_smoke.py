@@ -27,7 +27,9 @@ Everything runs on CPU (``JAX_PLATFORMS=cpu``) in under a minute.
 """
 
 import argparse
+import atexit
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -119,6 +121,9 @@ def wait_healthy(url: str, timeout_s: float = 90.0) -> None:
 
 def main() -> None:
     exedir = tempfile.mkdtemp(prefix="scale_smoke_exe_")
+    # replicas (including replacements spawned during teardown) read the
+    # store until the very end, so the dir comes down at process exit
+    atexit.register(shutil.rmtree, exedir, ignore_errors=True)
     os.environ["SCALE_SMOKE_EXEDIR"] = exedir
 
     # founding replica, by hand; the manager adopts its process
@@ -130,6 +135,16 @@ def main() -> None:
 
     router = RouterServer([url0], probe_interval_s=0.2, dispatch_retries=4,
                           max_inflight=2 * BURST_WORKERS)
+    # SPARKFLOW_TPU_RESTRACK=1: every router/replica<i>/* gauge family a
+    # spawned/drained/replaced replica publishes must leave the registry
+    # with it (deregister or stop) — churn is this smoke's whole point, so
+    # it doubles as the gauge-leak oracle
+    from sparkflow_tpu.analysis import restrack
+    retracker = restrack.ResourceTracker().install() \
+        if restrack.enabled() else None
+    if retracker is not None:
+        restrack.instrument_metrics(router.metrics,
+                                    prefixes=("router/replica",))
     router.start()
     manager = ReplicaManager(spawn_replica,
                              membership=router.membership,
@@ -157,6 +172,7 @@ def main() -> None:
         client.close()
 
     procs_killed = 0
+    clean = False
     try:
         # -- step up: saturate the singleton fleet ---------------------------
         threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -206,11 +222,19 @@ def main() -> None:
               f"client_failures={len(errors)} "
               f"gauges={ {k: v for k, v in g.items() if k.startswith('autoscaler/')} }",
               flush=True)
+        clean = True
     finally:
         stop_burst.set()
         scaler.stop()
         manager.stop_all(kill=True)
         router.stop()
+        if retracker is not None:
+            retracker.uninstall()
+            if clean:  # don't shadow a real failure with its leaks
+                retracker.assert_balanced()
+                print(f"restrack: zero unbalanced resources "
+                      f"({retracker.acquired} gauge families acquired, "
+                      f"{retracker.released} released)", flush=True)
 
 
 if __name__ == "__main__":

@@ -24,6 +24,13 @@ this library API:
   lockset race detector (GC-R402) for tests/chaos runs:
   :class:`RaceTracker` + drop-in lock/attribute instrumentation, enabled
   by ``SPARKFLOW_TPU_RACECHECK=1`` and free when off.
+- :mod:`~sparkflow_tpu.analysis.lifecycle` +
+  :mod:`~sparkflow_tpu.analysis.restrack` — resource lifecycles, both
+  directions: a static acquire/release pairing lint over a declarative
+  pair registry (leaks on escape/error, unreaped threads, gauge
+  namespaces with no cleanup — GC-X601..X604) and its runtime twin, a
+  per-resource balance tracker with acquisition stacks (GC-X605),
+  enabled by ``SPARKFLOW_TPU_RESTRACK=1`` and free when off.
 
 The repo keeps itself clean under the full pass: ``make lint-graft`` (and
 ``tests/test_analysis.py``) runs it over ``sparkflow_tpu/`` and
@@ -43,7 +50,7 @@ __all__ = [
     "run_static", "run_all",
     "lint_fn", "lint_train_step", "lint_apply",
     "ast_lint", "locks", "lockgraph", "jaxpr_lint", "racecheck",
-    "runtime_guards",
+    "runtime_guards", "lifecycle", "restrack",
 ]
 
 
@@ -57,6 +64,6 @@ def __getattr__(name):
     if name in ("run_static", "run_all"):
         return getattr(importlib.import_module(".cli", __name__), name)
     if name in ("ast_lint", "locks", "lockgraph", "jaxpr_lint", "racecheck",
-                "runtime_guards"):
+                "runtime_guards", "lifecycle", "restrack"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

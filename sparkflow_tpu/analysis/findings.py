@@ -104,6 +104,30 @@ RULES: Dict[str, Tuple[str, str]] = {
                 "pool to float before the page gather — a full-precision "
                 "transient copy of the whole cache that forfeits the memory "
                 "quantization bought; dequantize the gathered pages instead"),
+    # resource lifecycles (GC-X6xx): acquire/release pairing over the
+    # declarative registry in analysis/lifecycle.py
+    "GC-X601": ("leak-on-escape",
+                "a registered acquire (pool checkout, KV slot, tempdir) is "
+                "followed by an escaping path — early return, raise, break — "
+                "with no matching release, try/finally, or context manager "
+                "before it; that path leaks the resource"),
+    "GC-X602": ("release-skipped-on-error",
+                "code between a registered acquire and its release can "
+                "raise, and the release is not reachable from that error "
+                "branch (no try/finally or except-all that releases) — an "
+                "exception leaks the resource"),
+    "GC-X603": ("unreaped-thread",
+                "a started thread or spawned subprocess has no join/stop/"
+                "wait/reap on any path in its owning scope — shutdown "
+                "abandons it mid-flight"),
+    "GC-X604": ("gauge-namespace-leak",
+                "a class publishes metrics under a dynamic (per-entity) "
+                "namespace but no stop/close/deregister path removes them "
+                "— the exposition advertises ghost entities forever"),
+    "GC-X605": ("unbalanced-resource",
+                "the runtime ResourceTracker saw more acquires than "
+                "releases (or a double release) for a tracked resource by "
+                "the end of the run — acquisition stacks in detail"),
 }
 
 

@@ -469,3 +469,6 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        # a stopped autoscaler must not keep stale replicas/target in a
+        # shared registry — the next controller would read its ghost
+        self.metrics.remove_prefix("autoscaler/")

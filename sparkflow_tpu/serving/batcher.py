@@ -512,14 +512,22 @@ class ContinuousBatcher:
             self._closed = True
             if not drain:
                 failed = [p.future for p in self._pending]
-                failed += [p.future for p in self._active.values()]
                 self._pending.clear()
             self._cond.notify_all()
+        for w in self._workers:
+            w.join(timeout)
+        # workers are parked; whatever is still active (drain=False, a
+        # drain that timed out, or a prefill that landed mid-close) holds
+        # an engine slot and KV pages — retire them, or they leak
+        with self._cond:
+            abandoned = list(self._active.values())
+            self._active.clear()
+        for p in abandoned:
+            failed.append(p.future)
+            self.engine.release(p.slot)
         for f in failed:
             if not f.cancelled():
                 f.set_exception(RuntimeError("ContinuousBatcher closed"))
-        for w in self._workers:
-            w.join(timeout)
 
     def __enter__(self):
         return self
@@ -714,12 +722,14 @@ class ContinuousBatcher:
     def _prefill_loop(self) -> None:
         while True:
             with self._cond:
-                req = self._try_admit_locked()
-                while req is None and not self._closed:
-                    self._cond.wait(0.05 if self._pending else None)
-                    if self._closed:
-                        break
+                req = None
+                while not self._closed:
                     req = self._try_admit_locked()
-                if self._closed:
+                    if req is not None:
+                        break
+                    self._cond.wait(0.05 if self._pending else None)
+                if req is None:  # closed with nothing admitted
                     return
+            # an admission that raced close() still runs its prefill; the
+            # slot it activates is retired by close()'s abandoned sweep
             self._prefill_one(req)

@@ -88,11 +88,18 @@ class ConnectionPool:
         with self._lock:
             conn = self._idle.pop() if self._idle else None
         if conn is not None:
-            if conn.sock is not None:
-                conn.sock.settimeout(t)
+            try:
+                if conn.sock is not None:
+                    conn.sock.settimeout(t)
+                else:
+                    conn.timeout = t
+            except OSError:
+                # the idle socket died while pooled — close it and fall
+                # through to a fresh dial; raising here would leak a
+                # checked-out-but-never-returned connection
+                conn.close()
             else:
-                conn.timeout = t
-            return conn, True
+                return conn, True
         return http.client.HTTPConnection(self.host, self.port, timeout=t), \
             False
 

@@ -1677,56 +1677,69 @@ class DecodeEngine:
                 raise OutOfPages("no free decode slot")
             shared_pages, _saved = self.kv.alloc(
                 slot, prompt if self.prefix_cache else n, total)
-            t0 = time.perf_counter()
-            start = shared_pages * self.page_size  # first un-shared position
-            self._temp[slot] = float(temperature)
-            self._topk[slot] = min(int(top_k), self.max_top_k)
-            self._decode_ready[slot] = False
-            if seed is not None:
-                self._keys[slot] = np.asarray(jax.random.PRNGKey(int(seed)))
-            self._prefills += 1
-            self.metrics.observe("serving/decode/prompt_tokens", n)
-            if self.prefill_chunk is not None and n - start > self.prefill_chunk:
-                # chunked admission: the suffix rides the decode loop, one
-                # fused chunk per step; nothing blocks here
-                self._pending.append({"slot": int(slot), "prompt": prompt,
-                                      "next": start, "end": n,
-                                      "seed": seed, "t0": t0})
-                return {"slot": int(slot), "token": None, "prompt_len": n,
-                        "shared_tokens": start, "chunked": True}
-            if start == 0:
-                bucket = next(b for b in self.prefill_buckets if n <= b)
-                ids = np.zeros((1, bucket), np.int32)
-                ids[0, :n] = prompt
-                npages = bucket // self.page_size
-                page_ids = np.zeros(npages, np.int32)  # pad -> scratch page 0
-                held = self.kv.pages_for(n, self.page_size)
-                page_ids[:held] = self.kv.page_tables()[slot, :held]
-                exe = self._prefill_exes[bucket]
-                with obs_span("serving/decode_prefill",
-                              args={"bucket": bucket, "slot": int(slot)},
-                              jax_annotation=True):
-                    logits, self._k_pool, self._v_pool = exe(
-                        self._params, self._k_pool, self._v_pool, ids,
-                        np.asarray([n], np.int32), page_ids)
-            else:
-                logits = self._suffix_prefill_locked(slot, prompt, start, n)
-            if self.prefix_cache:
-                self.kv.commit_prefix(slot, prompt)  # K/V is on device now
-            if self._draft_model is not None:
-                # the draft keeps its own cache, so prefix hits on the
-                # target side still need a full draft prefill
-                self._draft_prefill_locked(slot, prompt)
-            tok, key = self._sample_exe(
-                np.asarray(logits), self._keys[slot][None],
-                np.asarray([temperature], np.float32),
-                np.asarray([min(int(top_k), self.max_top_k)], np.int32))
-            self._keys[slot] = np.asarray(key)[0]
-            first = int(np.asarray(tok)[0])
-            self._last_token[slot] = first
-            self._decode_ready[slot] = True
-            self.metrics.observe("serving/decode/prefill_ms",
-                                 (time.perf_counter() - t0) * 1000.0)
+            try:
+                t0 = time.perf_counter()
+                start = shared_pages * self.page_size  # first un-shared pos
+                self._temp[slot] = float(temperature)
+                self._topk[slot] = min(int(top_k), self.max_top_k)
+                self._decode_ready[slot] = False
+                if seed is not None:
+                    self._keys[slot] = np.asarray(
+                        jax.random.PRNGKey(int(seed)))
+                self._prefills += 1
+                self.metrics.observe("serving/decode/prompt_tokens", n)
+                if (self.prefill_chunk is not None
+                        and n - start > self.prefill_chunk):
+                    # chunked admission: the suffix rides the decode loop,
+                    # one fused chunk per step; nothing blocks here
+                    self._pending.append({"slot": int(slot),
+                                          "prompt": prompt,
+                                          "next": start, "end": n,
+                                          "seed": seed, "t0": t0})
+                    return {"slot": int(slot), "token": None,
+                            "prompt_len": n, "shared_tokens": start,
+                            "chunked": True}
+                if start == 0:
+                    bucket = next(b for b in self.prefill_buckets if n <= b)
+                    ids = np.zeros((1, bucket), np.int32)
+                    ids[0, :n] = prompt
+                    npages = bucket // self.page_size
+                    page_ids = np.zeros(npages, np.int32)  # pad -> page 0
+                    held = self.kv.pages_for(n, self.page_size)
+                    page_ids[:held] = self.kv.page_tables()[slot, :held]
+                    exe = self._prefill_exes[bucket]
+                    with obs_span("serving/decode_prefill",
+                                  args={"bucket": bucket, "slot": int(slot)},
+                                  jax_annotation=True):
+                        logits, self._k_pool, self._v_pool = exe(
+                            self._params, self._k_pool, self._v_pool, ids,
+                            np.asarray([n], np.int32), page_ids)
+                else:
+                    logits = self._suffix_prefill_locked(slot, prompt,
+                                                         start, n)
+                if self.prefix_cache:
+                    self.kv.commit_prefix(slot, prompt)  # K/V on device now
+                if self._draft_model is not None:
+                    # the draft keeps its own cache, so prefix hits on the
+                    # target side still need a full draft prefill
+                    self._draft_prefill_locked(slot, prompt)
+                tok, key = self._sample_exe(
+                    np.asarray(logits), self._keys[slot][None],
+                    np.asarray([temperature], np.float32),
+                    np.asarray([min(int(top_k), self.max_top_k)], np.int32))
+                self._keys[slot] = np.asarray(key)[0]
+                first = int(np.asarray(tok)[0])
+                self._last_token[slot] = first
+                self._decode_ready[slot] = True
+                self.metrics.observe("serving/decode/prefill_ms",
+                                     (time.perf_counter() - t0) * 1000.0)
+            except BaseException:
+                # a prefill that dies after alloc (OOM mid-executable, XLA
+                # error) must hand the slot's pages back before the error
+                # propagates — the caller never learns the slot id, so
+                # nobody else can release it
+                self._release_locked(int(slot))
+                raise
         return {"slot": int(slot), "token": first, "prompt_len": n,
                 "shared_tokens": start, "chunked": False}
 
@@ -2083,19 +2096,22 @@ class DecodeEngine:
         to the pool immediately (shared pages just drop one reference), the
         lane is reusable next step."""
         with self._lock:
-            self.kv.free(int(slot))
-            self._pending = [st for st in self._pending
-                             if st["slot"] != int(slot)]
-            # scrub any in-flight wave entry: if the lane is re-admitted
-            # before that wave exits, its stale token must not surface into
-            # the new request's stream
-            for w in self._wave_inflight:
-                self._wave_inflight[w] = [
-                    s for s in self._wave_inflight[w] if s != int(slot)]
-            self._decode_ready[slot] = False
-            self._last_token[slot] = 0
-            self._temp[slot] = 0.0
-            self._topk[slot] = 0
+            self._release_locked(int(slot))
+
+    def _release_locked(self, slot: int) -> None:
+        self.kv.free(slot)
+        self._pending = [st for st in self._pending
+                         if st["slot"] != slot]
+        # scrub any in-flight wave entry: if the lane is re-admitted
+        # before that wave exits, its stale token must not surface into
+        # the new request's stream
+        for w in self._wave_inflight:
+            self._wave_inflight[w] = [
+                s for s in self._wave_inflight[w] if s != slot]
+        self._decode_ready[slot] = False
+        self._last_token[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
 
     def active_slots(self) -> np.ndarray:
         return self.kv.active_slots()

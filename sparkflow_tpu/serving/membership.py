@@ -286,6 +286,10 @@ class Membership:
             self._prober = None
         for r in self._replicas:
             r.close()
+            # deregister() covers replicas that left while we ran; replicas
+            # still in the set at stop() need their gauges taken down here,
+            # or a shared registry keeps advertising the dead fleet
+            self.metrics.remove_prefix(f"router/replica{r.index}/")
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):

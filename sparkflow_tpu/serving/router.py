@@ -317,6 +317,10 @@ class CanaryController:
         self.canary = None
         self.rollbacks += 1
         self.metrics.incr("serving/canary_rollbacks")
+        # the version gets zero post-gate traffic from here on: take its
+        # gauges out of the exposition (stats() keeps the history — only
+        # the live per-version family is retired)
+        self.metrics.remove_prefix(f"serving/version{v}/")
         return v
 
     # -- membership version_policy hook --------------------------------------
@@ -364,8 +368,11 @@ class CanaryController:
         ``serving/version<v>/{requests,errors,latency_p95}`` plus the
         rollout state under ``serving/canary/*``."""
         with self._lock:
+            # quarantined versions serve nothing: publishing them would
+            # resurrect the family _rollback_locked just removed
             snap = {v: (st["requests"], st["errors"], self._p95(st["lat"]))
-                    for v, st in self._stats.items()}
+                    for v, st in self._stats.items()
+                    if v not in self.quarantined}
             inc, can = self.incumbent, self.canary
             nq, promos, rbs = (len(self.quarantined), self.promotions,
                                self.rollbacks)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import random
+import re
 import threading
 import time
 from collections import defaultdict, deque
@@ -238,6 +239,26 @@ class Metrics:
             for table in (self._scalars, self._counters, self._gauges,
                           self._hists):
                 for name in [n for n in table if n.startswith(prefix)]:
+                    del table[name]
+                    removed += 1
+        return removed
+
+    def remove_matching(self, match) -> int:
+        """Drop every series whose name matches ``match`` — a regex string
+        (``re.search`` semantics) or a ``name -> bool`` callable — across
+        all four tables; returns how many were removed. The general form of
+        :meth:`remove_prefix` for cleanups a prefix can't express (e.g.
+        one metric family across every replica: ``r"^router/replica\\d+/"
+        "kv_pages_free$"``)."""
+        if callable(match):
+            pred = match
+        else:
+            pred = re.compile(match).search
+        removed = 0
+        with self._lock:
+            for table in (self._scalars, self._counters, self._gauges,
+                          self._hists):
+                for name in [n for n in table if pred(n)]:
                     del table[name]
                     removed += 1
         return removed

@@ -1,0 +1,707 @@
+"""Resource-lifecycle analysis (GC-X601..X605): planted defects fire, the
+fixed twins stay silent, and the runtime tracker balances a real chaos run.
+
+Static side (:mod:`sparkflow_tpu.analysis.lifecycle`): one planted-defect /
+fixed-twin pair per rule —
+
+- GC-X601: a pool checkout with an early return (and a raise) before the
+  release; twins with try/finally, a context manager, a None-guard, and an
+  ownership transfer pass;
+- GC-X602: a call that can raise between acquire and release with nothing
+  routing the error branch through the release; try/finally and
+  releasing-handler twins pass;
+- GC-X603: started threads/subprocesses never joined/reaped, at class and
+  function scope; joined, loop-joined, and handed-off twins pass;
+- GC-X604: per-entity gauge namespaces with no cleanup on the *terminal*
+  teardown path — cleanup only in deregister is NOT enough (live entities
+  at stop() still leak, the PR 18 bug class);
+
+plus the inline-suppression contract and the ``handle_arg`` pairs
+(``kv.alloc(slot)``/``free(slot)``).
+
+Dynamic side (:mod:`sparkflow_tpu.analysis.restrack`): balance accounting
+with acquisition stacks, double-free detection, the env gate, the
+zero-overhead-when-off contract (instrumentors return their argument
+untouched — no wrapper in ``vars(obj)``), metrics-namespace tracking, and
+a chaos leak test: a ``ContinuousBatcher`` killed mid-generation
+(``close(drain=False)``) under the tracker ends with zero slot/admission
+balance and every abandoned future failed.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sparkflow_tpu.analysis import lifecycle, restrack
+from sparkflow_tpu.analysis.restrack import ResourceTracker
+from sparkflow_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str):
+    return [f.rule for f in lifecycle.lint_source(src)]
+
+
+# ---------------------------------------------------------------------------
+# GC-X601: leak on escape
+# ---------------------------------------------------------------------------
+
+_POOL_PREAMBLE = '''
+class ConnectionPool:
+    def acquire(self): ...
+    def release(self, conn, reuse=True): ...
+    def close(self): ...
+'''
+
+
+def test_x601_early_return_fires():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def bad(self, flag):
+        conn, reused = self.pool.acquire()
+        if flag:
+            return None          # leaks conn
+        self.pool.release(conn)
+        return flag
+'''
+    assert rules_of(src) == ["GC-X601"]
+
+
+def test_x601_raise_fires():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def bad(self, flag):
+        conn, reused = self.pool.acquire()
+        if flag:
+            raise ValueError(flag)   # leaks conn
+        self.pool.release(conn)
+'''
+    assert rules_of(src) == ["GC-X601"]
+
+
+def test_x601_try_finally_twin_silent():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def good(self, flag):
+        conn, reused = self.pool.acquire()
+        try:
+            if flag:
+                return None
+        finally:
+            self.pool.release(conn)
+        return flag
+'''
+    assert rules_of(src) == []
+
+
+def test_x601_context_manager_silent():
+    # an acquire consumed by a withitem is the CM protocol's to clean up
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def good(self, flag):
+        with self.pool.acquire() as conn:
+            if flag:
+                return None
+        return flag
+'''
+    assert rules_of(src) == []
+
+
+def test_x601_none_guard_silent():
+    # `if h is None: return` reacts to a FAILED acquire — nothing to release
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def good(self):
+        conn = self.pool.acquire()
+        if conn is None:
+            return None
+        self.pool.release(conn)
+        return True
+'''
+    assert rules_of(src) == []
+
+
+def test_x601_ownership_transfer_silent():
+    # returning / storing / passing the handle hands the release duty off
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def checkout(self):
+        conn, reused = self.pool.acquire()
+        return conn
+
+    def stash(self):
+        conn, reused = self.pool.acquire()
+        self._conn = conn
+        if not reused:
+            return None
+        return True
+'''
+    assert rules_of(src) == []
+
+
+def test_x601_kv_handle_arg():
+    # kv.alloc(slot, ...): the handle is the ARGUMENT, released by free(slot)
+    bad = '''
+class PagedKVCache:
+    def alloc(self, slot, n, total): ...
+    def free(self, slot): ...
+
+class Engine:
+    def __init__(self):
+        self.kv = PagedKVCache()
+
+    def bad(self, slot, n):
+        pages = self.kv.alloc(slot, n, n + 4)
+        if n > 64:
+            raise ValueError(n)   # pages leak
+        self.kv.free(slot)
+'''
+    assert rules_of(bad) == ["GC-X601"]
+    good = bad.replace("""        pages = self.kv.alloc(slot, n, n + 4)
+        if n > 64:
+            raise ValueError(n)   # pages leak
+        self.kv.free(slot)""", """        pages = self.kv.alloc(slot, n, n + 4)
+        try:
+            if n > 64:
+                raise ValueError(n)
+        finally:
+            self.kv.free(slot)""")
+    assert rules_of(good) == []
+
+
+def test_x601_inline_suppression():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def intentional(self, flag):
+        conn, reused = self.pool.acquire()
+        if flag:
+            return None  # graftcheck: disable=GC-X601
+        self.pool.release(conn)
+        return flag
+'''
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC-X602: release skipped on error
+# ---------------------------------------------------------------------------
+
+
+def test_x602_unprotected_risky_call_fires():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def bad(self, payload):
+        conn, reused = self.pool.acquire()
+        blob = encode(payload)        # can raise -> conn leaks
+        self.pool.release(conn)
+        return blob
+'''
+    assert rules_of(src) == ["GC-X602"]
+
+
+def test_x602_try_finally_twin_silent():
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def good(self, payload):
+        conn, reused = self.pool.acquire()
+        try:
+            data = send(conn, payload)
+        finally:
+            self.pool.release(conn)
+        return data
+'''
+    assert rules_of(src) == []
+
+
+def test_x602_releasing_handler_silent():
+    # an except that releases (the client.py _http shape) is protection
+    src = _POOL_PREAMBLE + '''
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def good(self, payload):
+        conn, reused = self.pool.acquire()
+        try:
+            data = send(conn, payload)
+        except Exception:
+            self.pool.release(conn, reuse=False)
+            raise
+        self.pool.release(conn)
+        return data
+'''
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC-X603: unreaped threads / subprocesses
+# ---------------------------------------------------------------------------
+
+
+def test_x603_class_thread_never_joined_fires():
+    src = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def _run(self): ...
+'''
+    assert rules_of(src) == ["GC-X603"]
+
+
+def test_x603_joined_twin_silent():
+    src = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=5.0)
+
+    def _run(self): ...
+'''
+    assert rules_of(src) == []
+
+
+def test_x603_loop_alias_join_silent():
+    # `for w in self._workers: w.join()` must count as reaping the attr
+    src = '''
+import threading
+
+class Pool:
+    def __init__(self, n):
+        self._workers = [threading.Thread(target=self._run)
+                         for _ in range(n)]
+
+    def start(self):
+        for w in self._workers:
+            w.start()
+
+    def close(self):
+        for w in self._workers:
+            w.join()
+
+    def _run(self): ...
+'''
+    assert rules_of(src) == []
+
+
+def test_x603_local_thread_fires_and_handoff_silent():
+    bad = '''
+import threading
+
+def bad():
+    t = threading.Thread(target=work)
+    t.start()
+'''
+    assert rules_of(bad) == ["GC-X603"]
+    joined = bad.replace("    t.start()\n",
+                         "    t.start()\n    t.join()\n")
+    assert rules_of(joined) == []
+    handed_off = bad.replace("    t.start()\n",
+                             "    t.start()\n    registry.adopt(t)\n")
+    assert rules_of(handed_off) == []
+
+
+def test_x603_class_subprocess_never_reaped_fires():
+    # Popen has no .start(): the ctor assignment IS the start, and
+    # send_signal is not a reap — nothing ever waits/kills -> zombie
+    bad = '''
+import subprocess
+
+class Manager:
+    def spawn(self):
+        self._proc = subprocess.Popen(["sleep", "1"])
+
+    def kick(self):
+        self._proc.send_signal(9)
+'''
+    assert rules_of(bad) == ["GC-X603"]
+    fixed = bad.replace("    def kick(self):\n"
+                        "        self._proc.send_signal(9)",
+                        "    def stop(self):\n"
+                        "        self._proc.kill()\n"
+                        "        self._proc.wait()")
+    assert rules_of(fixed) == []
+
+
+def test_x603_local_subprocess():
+    bad = '''
+import subprocess
+
+def bad():
+    p = subprocess.Popen(["sleep", "1"])
+    p.send_signal(9)
+'''
+    assert rules_of(bad) == ["GC-X603"]
+    reaped = bad.replace("    p.send_signal(9)\n", "    p.wait()\n")
+    assert rules_of(reaped) == []
+    handed_off = bad.replace("    p.send_signal(9)\n",
+                             "    manager.adopt(p)\n")
+    assert rules_of(handed_off) == []
+
+
+# ---------------------------------------------------------------------------
+# GC-X604: gauge namespace without terminal cleanup
+# ---------------------------------------------------------------------------
+
+_GAUGE_BAD = '''
+class Fleet:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def publish(self, idx, depth):
+        self.metrics.gauge(f"fleet/replica{idx}/depth", depth)
+
+    def stop(self):
+        self._running = False
+'''
+
+
+def test_x604_dynamic_gauges_no_cleanup_fires():
+    assert rules_of(_GAUGE_BAD) == ["GC-X604"]
+
+
+def test_x604_cleanup_in_stop_silent():
+    src = _GAUGE_BAD.replace(
+        "        self._running = False",
+        "        self._running = False\n"
+        "        self.metrics.remove_prefix(\"fleet/replica\")")
+    assert rules_of(src) == []
+
+
+def test_x604_transitive_cleanup_silent():
+    # stop() -> self._teardown() -> remove_matching counts (fixpoint)
+    src = _GAUGE_BAD.replace(
+        "        self._running = False",
+        "        self._running = False\n"
+        "        self._teardown()\n\n"
+        "    def _teardown(self):\n"
+        "        self.metrics.remove_matching(r\"^fleet/replica\\d+/\")")
+    assert rules_of(src) == []
+
+
+def test_x604_deregister_alone_is_not_enough():
+    # the PR 18 bug class: per-entity deregister cleans, stop() doesn't —
+    # entities still live at stop() leak their gauges
+    src = _GAUGE_BAD.replace(
+        "    def stop(self):",
+        "    def deregister(self, idx):\n"
+        "        self.metrics.remove_prefix(f\"fleet/replica{idx}/\")\n\n"
+        "    def stop(self):")
+    assert rules_of(src) == ["GC-X604"]
+
+
+def test_x604_static_names_exempt():
+    src = '''
+class Controller:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def publish(self):
+        self.metrics.gauge("controller/target", 1.0)
+
+    def stop(self):
+        self._running = False
+'''
+    assert rules_of(src) == []
+
+
+def test_x604_no_lifecycle_method_out_of_scope():
+    src = '''
+class Recorder:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def publish(self, idx):
+        self.metrics.gauge(f"rec/shard{idx}/lag", 0.0)
+'''
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# whole-path plumbing: lint_paths over real files
+# ---------------------------------------------------------------------------
+
+
+def test_lint_paths_cross_file_types(tmp_path):
+    # the receiver type comes from ANOTHER file's class definition
+    (tmp_path / "poolmod.py").write_text(_POOL_PREAMBLE)
+    (tmp_path / "clientmod.py").write_text('''
+from poolmod import ConnectionPool
+
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def bad(self, flag):
+        conn, reused = self.pool.acquire()
+        if flag:
+            return None
+        self.pool.release(conn)
+        return flag
+''')
+    findings = lifecycle.lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["GC-X601"]
+    assert findings[0].path.endswith("clientmod.py")
+
+
+# ---------------------------------------------------------------------------
+# ResourceTracker battery
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_balance_and_stacks():
+    t = ResourceTracker()
+    with t:
+        t.acquire("kv-slot", 0)
+        t.acquire("kv-slot", 0)
+        t.acquire("kv-slot", 1)
+        t.release("kv-slot", 0)
+    assert t.balance() == 2
+    assert t.balance("kv-slot") == 2
+    assert t.balance("http-conn") == 0
+    live = t.live()
+    assert len(live[("kv-slot", 0)]) == 1
+    assert len(live[("kv-slot", 1)]) == 1
+    fs = t.findings()
+    assert {f.rule for f in fs} == {"GC-X605"}
+    assert all("test_lifecycle" in s for f in fs
+               for s in f.detail["stacks"])
+    with pytest.raises(AssertionError, match="restrack"):
+        t.assert_balanced()
+
+
+def test_tracker_clean_run_silent():
+    t = ResourceTracker()
+    t.acquire("x", "a")
+    t.release("x", "a")
+    assert t.balance() == 0
+    assert t.findings() == []
+    t.assert_balanced()
+
+
+def test_tracker_double_free_detected():
+    t = ResourceTracker()
+    t.acquire("x", 1)
+    t.release("x", 1)
+    t.release("x", 1)
+    fs = t.findings()
+    assert len(fs) == 1 and fs[0].detail.get("double_release")
+    with pytest.raises(AssertionError):
+        t.assert_balanced()
+
+
+def test_tracker_release_if_live_is_idempotent():
+    t = ResourceTracker()
+    t.acquire("x", 1)
+    assert t.release_if_live("x", 1)
+    assert not t.release_if_live("x", 1)   # no double-free violation
+    assert t.findings() == []
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.delenv("SPARKFLOW_TPU_RESTRACK", raising=False)
+    assert not restrack.enabled()
+    monkeypatch.setenv("SPARKFLOW_TPU_RESTRACK", "0")
+    assert not restrack.enabled()
+    monkeypatch.setenv("SPARKFLOW_TPU_RESTRACK", "1")
+    assert restrack.enabled()
+
+
+def test_install_nesting_restores_outer():
+    outer, inner = ResourceTracker(), ResourceTracker()
+    outer.install()
+    try:
+        assert restrack.active() is outer
+        with inner:
+            assert restrack.active() is inner
+        assert restrack.active() is outer
+    finally:
+        outer.uninstall()
+    assert restrack.active() is None
+
+
+def test_zero_overhead_when_off():
+    # without an installed tracker every instrumentor is an identity
+    # function: same object back, NO wrapper shadowing the methods — the
+    # disabled-path cost is the single `_ACTIVE is None` check
+    assert restrack.active() is None
+
+    class Pool:
+        def acquire(self):
+            return (object(), False)
+
+        def release(self, conn, reuse=True):
+            pass
+
+    p = Pool()
+    assert restrack.instrument_pool(p) is p
+    assert "acquire" not in vars(p) and "release" not in vars(p)
+    m = Metrics()
+    assert restrack.instrument_metrics(m, prefixes=("x/",)) is m
+    assert "gauge" not in vars(m)
+
+
+def test_instrument_pool_tracks_checkouts():
+    class Pool:
+        def __init__(self):
+            self.conn = object()
+
+        def acquire(self):
+            return (self.conn, True)
+
+        def release(self, conn, reuse=True):
+            pass
+
+    t = ResourceTracker()
+    with t:
+        p = restrack.instrument_pool(Pool())
+        conn, _ = p.acquire()
+        assert t.balance("http-conn") == 1
+        p.release(conn, reuse=False)
+        assert t.balance("http-conn") == 0
+    assert t.findings() == []
+
+
+def test_instrument_metrics_namespaces():
+    m = Metrics()
+    t = ResourceTracker()
+    with t:
+        restrack.instrument_metrics(m, prefixes=("router/replica",))
+        m.gauge("router/replica0/healthy", 1.0)
+        m.gauge("router/replica0/healthy", 0.0)   # same name: one acquire
+        m.gauge("router/replica1/depth", 3.0)
+        m.gauge("process/uptime", 9.0)            # outside prefixes
+        assert t.balance("gauge-ns") == 2
+        assert m.remove_prefix("router/replica0/") == 1
+        assert t.balance("gauge-ns") == 1
+        assert m.remove_matching(r"^router/replica\d+/depth$") == 1
+        assert t.balance("gauge-ns") == 0
+    assert t.findings() == []
+    assert m.gauges() == {"process/uptime": 9.0}
+
+
+def test_metrics_remove_matching_unit():
+    m = Metrics()
+    m.gauge("a/1/x", 1.0)
+    m.incr("a/2/x")
+    m.observe("a/3/x", 0.5)
+    m.scalar("b/keep", 2.0)
+    assert m.remove_matching(r"^a/\d+/x$") == 3
+    assert m.remove_matching(lambda n: n.startswith("b/")) == 1
+    assert m.summary()["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# chaos leak test: kill a generation mid-stream under the tracker
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_engine():
+    import jax
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    from sparkflow_tpu.serving import DecodeEngine
+    spec = build_registry_spec("transformer_lm", vocab_size=61, hidden=16,
+                               num_layers=1, num_heads=2, mlp_dim=32,
+                               max_len=64, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    return DecodeEngine(model, params, num_slots=2, page_size=8, seed=0)
+
+
+def test_chaos_kill_mid_generation_zero_balance(small_engine):
+    from sparkflow_tpu.serving import ContinuousBatcher
+    engine = small_engine
+    t = ResourceTracker().install()
+    try:
+        restrack.instrument_engine(engine)
+        batcher = ContinuousBatcher(engine, max_queue=16)
+        restrack.instrument_batcher(batcher)
+        futures = [batcher.submit([3, 5, 7], max_new_tokens=48)
+                   for _ in range(4)]
+        deadline = time.monotonic() + 30.0
+        while batcher.inflight_rows() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert batcher.inflight_rows() > 0, "nothing ever got admitted"
+        # the client is gone mid-stream: hard close, no drain
+        batcher.close(drain=False)
+        # every abandoned future must resolve (exception), every slot and
+        # admission must be paid back — zero balance or the stacks tell us
+        # which acquire leaked
+        for f in futures:
+            assert f.done()
+            if not f.cancelled():
+                with pytest.raises(RuntimeError):
+                    f.result(timeout=0)
+    finally:
+        t.uninstall()
+    assert t.balance("decode-slot") == 0
+    assert t.balance("batch-slot") == 0
+    t.assert_balanced()
+    assert t.acquired > 0  # the oracle actually saw checkouts
+
+
+def test_drain_close_is_balanced_too(small_engine):
+    from sparkflow_tpu.serving import ContinuousBatcher
+    engine = small_engine
+    t = ResourceTracker().install()
+    try:
+        restrack.instrument_engine(engine)
+        batcher = ContinuousBatcher(engine, max_queue=16)
+        restrack.instrument_batcher(batcher)
+        futures = [batcher.submit([2 + i, 9], max_new_tokens=3)
+                   for i in range(3)]
+        batcher.close(drain=True, timeout=60.0)
+        for f in futures:
+            out = f.result(timeout=0)
+            assert out["num_tokens"] > 0
+    finally:
+        t.uninstall()
+    t.assert_balanced()
+    assert t.acquired >= 6  # 3 decode slots + 3 admissions

@@ -1,0 +1,120 @@
+"""The graftcheck CI gate: the FULL static pass (every GC family — AST
+lint, jaxpr-free sharding checks, lock discipline, lock-order graph,
+policy parity, resource lifecycles) over the repo's own source +
+examples must report ZERO findings.
+
+This is the tier-1 twin of ``make lint-graft-strict``: a regression that
+introduces a lock-order cycle, an unguarded shared field, a leaked pool
+checkout, or an uncleaned per-entity gauge namespace fails CI here, with
+the finding rendered in the assertion message.
+
+Also pins the gate's mechanics: the CLI exits nonzero on any finding and
+zero on a clean tree, and ``--baseline`` / ``--write-baseline`` let a
+repo adopt the linter incrementally without suppressing NEW findings.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sparkflow_tpu.analysis import cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DEFECT = '''
+class ConnectionPool:
+    def acquire(self): ...
+    def release(self, conn, reuse=True): ...
+
+class Client:
+    def __init__(self):
+        self.pool = ConnectionPool()
+
+    def bad(self, flag):
+        conn, reused = self.pool.acquire()
+        if flag:
+            return None
+        self.pool.release(conn)
+        return flag
+'''
+
+_SECOND_DEFECT = '''
+import threading
+
+def orphan():
+    t = threading.Thread(target=print)
+    t.start()
+'''
+
+
+def test_repo_full_static_pass_clean():
+    paths = [os.path.join(REPO, "sparkflow_tpu"),
+             os.path.join(REPO, "examples")]
+    findings = cli.run_static(paths)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    (tmp_path / "leaky.py").write_text(_DEFECT)
+    rc = cli.main([str(tmp_path), "--no-trace", "--format", "json"])
+    out = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+           if ln.strip()]
+    assert rc == 1
+    assert [f["rule"] for f in out] == ["GC-X601"]
+
+    (tmp_path / "leaky.py").write_text(_DEFECT.replace(
+        "        if flag:\n            return None\n", ""))
+    assert cli.main([str(tmp_path), "--no-trace"]) == 0
+
+
+def test_baseline_roundtrip(tmp_path, capsys):
+    (tmp_path / "leaky.py").write_text(_DEFECT)
+    baseline = str(tmp_path / "graftcheck-baseline.jsonl")
+
+    # adopt: snapshot today's findings, exit 0
+    assert cli.main([str(tmp_path), "--no-trace",
+                     "--write-baseline", baseline]) == 0
+    capsys.readouterr()
+    with open(baseline) as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+    assert [ln["rule"] for ln in lines] == ["GC-X601"]
+
+    # known findings are filtered: the gate stays green...
+    assert cli.main([str(tmp_path), "--no-trace",
+                     "--baseline", baseline]) == 0
+    capsys.readouterr()
+
+    # ...but a NEW finding still fails, and only the new one is shown
+    (tmp_path / "orphan.py").write_text(_SECOND_DEFECT)
+    rc = cli.main([str(tmp_path), "--no-trace",
+                   "--baseline", baseline, "--format", "json"])
+    out = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+           if ln.strip()]
+    assert rc == 1
+    assert [f["rule"] for f in out] == ["GC-X603"]
+
+
+def test_baseline_is_line_insensitive(tmp_path, capsys):
+    # shifting the file (new imports above) must not invalidate the
+    # baseline: keys are (rule, path, message), not line numbers
+    (tmp_path / "leaky.py").write_text(_DEFECT)
+    baseline = str(tmp_path / "b.jsonl")
+    assert cli.main([str(tmp_path), "--no-trace",
+                     "--write-baseline", baseline]) == 0
+    (tmp_path / "leaky.py").write_text("import os\nimport sys\n" + _DEFECT)
+    assert cli.main([str(tmp_path), "--no-trace",
+                     "--baseline", baseline]) == 0
+    capsys.readouterr()
+
+
+def test_make_target_runs_full_pass():
+    # the Makefile gate must lint BOTH trees and hard-fail on findings
+    # (json format: exit 1 kills make on any finding)
+    with open(os.path.join(REPO, "Makefile")) as fh:
+        mk = fh.read()
+    assert "lint-graft-strict:" in mk
+    line = next(ln for ln in mk.splitlines()
+                if "sparkflow_tpu.analysis" in ln and "--format json" in ln)
+    assert "sparkflow_tpu examples" in line
