@@ -1,7 +1,7 @@
 # Developer entry points (role parity with the reference's Makefile:1-17,
 # which ran the examples and tests in Docker).
 
-.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft lint-graft-strict obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke scale-smoke
+.PHONY: test test-fast test-pyspark docker-test-pyspark bench bench-ladder mfu-sweep baseline examples native clean serve-smoke sim-smoke fleet-smoke chaos-smoke lint-graft lint-graft-strict obs-smoke span-overhead elastic-smoke decode-smoke spec-smoke tp-smoke pp-smoke zero-smoke race-smoke swap-smoke kvquant-smoke scale-smoke trace-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -200,6 +200,17 @@ obs-smoke:
 
 span-overhead:
 	JAX_PLATFORMS=cpu python bench.py --span-overhead
+
+# distributed-tracing smoke: the tracing test battery (traceparent context,
+# cross-process assembly, tail sampling, flight recorder + harvest), then a
+# real 2-replica fleet: one hedged /v1/generate assembled into a single
+# cross-process waterfall with the hedge loser labeled, and a SIGKILL
+# postmortem naming the in-flight trace ids; finishes with the
+# tracing-overhead benchmark (>= 0.98x tracing-off, docs/observability.md)
+trace-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+	JAX_PLATFORMS=cpu PYTHONPATH=".:$$PYTHONPATH" python examples/trace_smoke.py
+	JAX_PLATFORMS=cpu python bench.py --trace-overhead
 
 # round-2 example additions (text pipeline; TF1 migration needs tensorflow)
 examples-extra:

@@ -16,7 +16,7 @@ import re
 import sys
 from typing import List, Optional, Sequence
 
-from . import ast_lint, lifecycle, lockgraph, locks, policy_lint
+from . import ast_lint, lifecycle, lockgraph, locks, policy_lint, tracelint
 from .findings import RULES, Finding, format_findings
 
 __all__ = ["main", "run_static", "run_all", "load_baseline",
@@ -26,10 +26,10 @@ __all__ = ["main", "run_static", "run_all", "load_baseline",
 def run_static(paths: Sequence[str]) -> List[Finding]:
     """ast_lint + per-class lock coverage + the whole-package lock graph
     (deadlock/blocking-under-lock) + pure-policy purity + resource
-    lifecycles over every .py under ``paths``."""
+    lifecycles + trace-propagation over every .py under ``paths``."""
     return (ast_lint.lint_paths(paths) + locks.lint_paths(paths)
             + lockgraph.lint_paths(paths) + policy_lint.lint_paths(paths)
-            + lifecycle.lint_paths(paths))
+            + lifecycle.lint_paths(paths) + tracelint.lint_paths(paths))
 
 
 def _baseline_key(d: dict) -> tuple:

@@ -128,6 +128,14 @@ RULES: Dict[str, Tuple[str, str]] = {
                 "the runtime ResourceTracker saw more acquires than "
                 "releases (or a double release) for a tracked resource by "
                 "the end of the run — acquisition stacks in detail"),
+    # tracelint (GC-T7xx): distributed-tracing propagation
+    "GC-T701": ("untraced-dispatch",
+                "a registered cross-process dispatch site (marked "
+                "`# graftcheck: dispatch-site`) sends a request without "
+                "propagating trace context — no traceparent header "
+                "reference in the enclosing function and no trace-carrying "
+                "argument at the call, so the callee's spans fall off the "
+                "request's timeline"),
 }
 
 

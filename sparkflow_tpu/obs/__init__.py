@@ -1,7 +1,15 @@
-"""Unified observability: spans, step-phase stats, metric exporters.
+"""Unified observability: spans, trace assembly, flight recorder, exporters.
 
 - :mod:`~sparkflow_tpu.obs.spans` — ``Span``/``Tracer``: nested host-side
-  timing with Chrome-trace / JSONL export and cross-thread propagation.
+  timing with Chrome-trace / JSONL export and cross-thread propagation,
+  plus ``TraceContext``: the W3C-traceparent-style context that carries a
+  trace across processes.
+- :mod:`~sparkflow_tpu.obs.collector` — ``TraceCollector``: router-side
+  tail-sampled assembly of cross-process request timelines (one waterfall
+  per kept request, Chrome-trace / JSONL export).
+- :mod:`~sparkflow_tpu.obs.flight` — ``FlightRecorder``: always-on bounded
+  crash flight recorder, dumped on SIGTERM/atexit and harvested by the
+  ``ReplicaManager`` when a replica dies.
 - :mod:`~sparkflow_tpu.obs.stepstats` — ``StepStats``: per-step phase
   breakdown (transfer / compile / step / metrics / checkpoint) + derived
   throughput and MFU gauges for ``Trainer.fit``.
@@ -12,12 +20,18 @@
 See ``docs/observability.md`` for the end-to-end walkthrough.
 """
 
-from .spans import Span, Tracer, current_tracer, default_tracer, span
+from .spans import (Span, TraceContext, Tracer, current_tracer,
+                    default_tracer, span)
 from .stepstats import StepStats
+from .collector import TraceCollector, trace_spans
+from .flight import FlightRecorder, harvest_flight
 from .exporters import MemoryWatcher, prometheus_name, prometheus_text
 
 __all__ = [
-    "Span", "Tracer", "current_tracer", "default_tracer", "span",
+    "Span", "TraceContext", "Tracer", "current_tracer", "default_tracer",
+    "span",
     "StepStats",
+    "TraceCollector", "trace_spans",
+    "FlightRecorder", "harvest_flight",
     "MemoryWatcher", "prometheus_name", "prometheus_text",
 ]

@@ -51,22 +51,49 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+class _NameTable:
+    """Registry name → unique Prometheus name. Sanitization is lossy
+    (``a/b`` and ``a.b`` both become ``a_b``), so colliding names get a
+    numeric suffix instead of silently overwriting each other in the
+    exposition. Deterministic: families are rendered in sorted order, so
+    the same registry always yields the same suffixes."""
+
+    def __init__(self):
+        self._owner: Dict[str, str] = {}   # prometheus name -> registry name
+
+    def resolve(self, name: str) -> str:
+        pn = prometheus_name(name)
+        if self._owner.get(pn, name) == name:
+            self._owner[pn] = name
+            return pn
+        i = 2
+        while True:
+            cand = f"{pn}_{i}"
+            if self._owner.get(cand, name) == name:
+                self._owner[cand] = name
+                return cand
+            i += 1
+
+
 def prometheus_text(metrics: Optional[Metrics] = None) -> str:
     """Render ``metrics`` (default: the process registry) as Prometheus
     text exposition. Safe to call from any thread; takes one consistent
-    registry snapshot."""
+    registry snapshot. Every family gets ``# HELP`` (carrying the original
+    registry name) and ``# TYPE``; registry names whose sanitized forms
+    collide are de-duplicated with a ``_2``/``_3``... suffix."""
     m = metrics if metrics is not None else default_metrics
     scalars, counters, gauges, hists = m._snapshot()
+    names = _NameTable()
     lines = []
 
     for name in sorted(counters):
-        pn = prometheus_name(name)
+        pn = names.resolve(name)
         lines.append(f"# HELP {pn} counter {name}")
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {_fmt(counters[name])}")
 
     for name in sorted(gauges):
-        pn = prometheus_name(name)
+        pn = names.resolve(name)
         value, _ts = gauges[name]
         lines.append(f"# HELP {pn} gauge {name}")
         lines.append(f"# TYPE {pn} gauge")
@@ -78,7 +105,7 @@ def prometheus_text(metrics: Optional[Metrics] = None) -> str:
         pts = scalars[name]
         if not pts:
             continue
-        pn = prometheus_name(name)
+        pn = names.resolve(name)
         lines.append(f"# HELP {pn} last value of scalar series {name}")
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_fmt(pts[-1][1])}")
@@ -86,7 +113,7 @@ def prometheus_text(metrics: Optional[Metrics] = None) -> str:
     # histograms → Prometheus summary: quantile samples + _sum + _count
     for name in sorted(hists):
         h = hists[name]
-        pn = prometheus_name(name)
+        pn = names.resolve(name)
         lines.append(f"# HELP {pn} summary of {name}")
         lines.append(f"# TYPE {pn} summary")
         for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):

@@ -29,6 +29,18 @@ backoffs, serving requests) go through the module-level :func:`span`, which
 routes to the innermost :meth:`Tracer.activate`-d tracer on this thread
 (``default_tracer`` otherwise), so a traced ``fit`` collects its own
 checkpoint spans without any plumbing through call signatures.
+
+Fleet-native tracing: :class:`TraceContext` is a W3C-traceparent-style
+context (128-bit trace id, parent span id, sampled flag) minted at the
+router (or accepted from the client) and carried over HTTP alongside
+``X-Request-Id``. Span ids are process-local ``itertools.count`` integers,
+so exports namespace them with the tracer's :attr:`Tracer.fingerprint`
+(``"<pidhex><random>:<n>"``) — merged multi-process traces cannot collide —
+and each tracer carries one ``(perf_counter, epoch)`` origin pair so
+intervals recorded in different processes land on ONE wall-clock timeline
+(:meth:`Tracer.wall_time`). Assembly/sampling live in
+:mod:`sparkflow_tpu.obs.collector`; the crash flight recorder in
+:mod:`sparkflow_tpu.obs.flight`.
 """
 
 from __future__ import annotations
@@ -39,10 +51,12 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Union
 
-__all__ = ["Span", "Tracer", "default_tracer", "span", "current_tracer"]
+__all__ = ["Span", "TraceContext", "Tracer", "default_tracer", "span",
+           "current_tracer"]
 
 _span_ids = itertools.count(1)
 _now = time.perf_counter
@@ -52,6 +66,84 @@ _get_ident = threading.get_ident
 # months-long serving process cannot grow without limit (same contract as
 # the metrics histogram reservoir).
 MAX_SPANS = 65536
+
+#: HTTP header that carries a :class:`TraceContext` across processes,
+#: alongside the existing ``X-Request-Id`` plumbing.
+TRACEPARENT_HEADER = "traceparent"
+
+_NO_PARENT = "0" * 16  # traceparent parent field for "no parent span"
+
+
+class TraceContext:
+    """W3C-traceparent-style context: ``00-<trace_id>-<parent>-<flags>``.
+
+    ``trace_id`` is 32 hex chars (128 bits), minted once per request at the
+    router (or accepted from the client) and carried through every process
+    the request touches. ``parent`` is the *exported* span uid of the span
+    the next process should hang its root under — a
+    ``"<fingerprint>:<n>"`` string (no dashes, so the 4-field dash format
+    still splits), or the all-zero sentinel for "no parent". ``sampled``
+    rides the flags octet; tail-based retention decisions happen at the
+    collector, so the flag is a head-sampling hint, not the verdict.
+    """
+
+    __slots__ = ("trace_id", "parent", "sampled")
+
+    def __init__(self, trace_id: str, parent: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.parent = parent
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def mint(cls, sampled: bool = True) -> "TraceContext":
+        """A fresh 128-bit trace id with no parent span."""
+        return cls(uuid.uuid4().hex, None, sampled)
+
+    @classmethod
+    def parse(cls, header: Optional[str]) -> Optional["TraceContext"]:
+        """Tolerant decode of a ``traceparent`` header; None on anything
+        malformed (a bad client header must never fail the request —
+        the router just mints a fresh context instead)."""
+        if not header:
+            return None
+        parts = header.strip().split("-")
+        if len(parts) != 4 or parts[0] != "00":
+            return None
+        trace_id, parent, flags = parts[1], parts[2], parts[3]
+        if len(trace_id) != 32 or not _is_hex(trace_id):
+            return None
+        if int(trace_id, 16) == 0:
+            return None
+        if parent == _NO_PARENT:
+            parent = None
+        try:
+            sampled = bool(int(flags, 16) & 0x01)
+        except ValueError:
+            return None
+        return cls(trace_id, parent, sampled)
+
+    def to_header(self) -> str:
+        return (f"00-{self.trace_id}-{self.parent or _NO_PARENT}-"
+                f"{'01' if self.sampled else '00'}")
+
+    def child(self, parent_uid: str) -> "TraceContext":
+        """Same trace, re-parented under an exported span uid — what the
+        router stamps per dispatch attempt so each replica's spans hang
+        under the attempt that actually reached it."""
+        return TraceContext(self.trace_id, parent_uid, self.sampled)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, parent={self.parent!r}, "
+                f"sampled={self.sampled})")
+
+
+def _is_hex(s: str) -> bool:
+    try:
+        int(s, 16)
+        return True
+    except ValueError:
+        return False
 
 
 class Span:
@@ -131,16 +223,45 @@ class _SpanCtx:
         return False
 
 
+class _NoopSpanCtx:
+    """Shared do-nothing handle returned by a disabled tracer's
+    :meth:`Tracer.span` — the tracing-off baseline ``bench.py
+    --trace-overhead`` compares against."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> Optional[Span]:
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CTX = _NoopSpanCtx()
+
+
 class Tracer:
     """Collects finished spans from any number of threads.
 
     ``max_spans`` bounds the ring (oldest dropped first; :meth:`dropped`
     reports how many). Each thread keeps its own span stack, so nesting
     inside one thread needs no lock; only the final commit does.
+
+    ``enabled=False`` turns the tracer into a no-op (``span()`` returns a
+    shared null context, ``record()`` drops the span) — the off-baseline
+    for overhead benchmarks and a kill switch for span-heavy sites.
+
+    :attr:`fingerprint` namespaces this tracer's process-local span-id
+    counter at export time (``"<pidhex><random>:<n>"`` via
+    :meth:`span_uid`), so spans merged from many processes — or many
+    tracers — cannot collide; :meth:`wall_time` maps the tracer's
+    ``perf_counter`` stamps onto the wall clock with one origin pair, so
+    merged intervals share a timeline.
     """
 
-    def __init__(self, max_spans: int = MAX_SPANS):
+    def __init__(self, max_spans: int = MAX_SPANS, enabled: bool = True):
         self.max_spans = int(max_spans)
+        self.enabled = bool(enabled)
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=self.max_spans)
         self._total = 0
@@ -150,6 +271,23 @@ class Tracer:
         # stamps onto the wall clock
         self._origin = time.perf_counter()
         self._origin_epoch = time.time()
+        # per-process (and per-tracer) fingerprint: span ids come from a
+        # process-local itertools.count, so merged multi-process traces
+        # need this namespace to keep ids collision-free
+        self.fingerprint = f"{os.getpid():x}{uuid.uuid4().hex[:6]}"
+
+    # -- cross-process identity ----------------------------------------------
+
+    def span_uid(self, span_id: Optional[int]) -> Optional[str]:
+        """Exported (fingerprinted) form of a process-local span id."""
+        if span_id is None:
+            return None
+        return f"{self.fingerprint}:{span_id}"
+
+    def wall_time(self, t: float) -> float:
+        """Map one of this tracer's ``perf_counter`` stamps onto the wall
+        clock (epoch seconds) via the tracer's origin pair."""
+        return self._origin_epoch + (t - self._origin)
 
     # -- recording -----------------------------------------------------------
 
@@ -167,17 +305,23 @@ class Tracer:
 
     def span(self, name: str, args: Optional[Dict[str, Any]] = None,
              parent: Union[Span, int, None] = None,
-             jax_annotation: bool = False) -> _SpanCtx:
+             jax_annotation: bool = False):
         """``with tracer.span('phase') as sp:`` — times the block, nests
-        under the current span (or the explicit ``parent``)."""
+        under the current span (or the explicit ``parent``). A disabled
+        tracer returns a shared no-op context (``sp`` is None)."""
+        if not self.enabled:
+            return _NOOP_CTX
         return _SpanCtx(self, name, args, parent, jax_annotation)
 
     def record(self, name: str, t0: float, t1: float,
                parent: Union[Span, int, None] = None,
-               args: Optional[Dict[str, Any]] = None) -> Span:
+               args: Optional[Dict[str, Any]] = None) -> Optional[Span]:
         """Post-hoc span from already-measured ``perf_counter`` stamps (how
         the micro-batcher reconstructs each request's queue-wait interval
-        after the batch completes)."""
+        after the batch completes). Dropped (returns None) when the tracer
+        is disabled."""
+        if not self.enabled:
+            return None
         parent_id = parent.span_id if isinstance(parent, Span) else parent
         sp = Span(name, parent_id, threading.get_ident(), t0, args)
         sp.t1 = t1
@@ -242,9 +386,11 @@ class Tracer:
         for s in spans:
             t1 = s.t1 if s.t1 is not None else s.t0
             args = dict(s.args) if s.args else {}
-            args["span_id"] = s.span_id
+            # export-time namespacing: the raw ids are process-local
+            # counters; the fingerprint keeps merged traces collision-free
+            args["span_id"] = self.span_uid(s.span_id)
             if s.parent_id is not None:
-                args["parent_id"] = s.parent_id
+                args["parent_id"] = self.span_uid(s.parent_id)
             events.append({
                 "name": s.name, "ph": "X", "cat": "obs",
                 "ts": round((s.t0 - origin) * 1e6, 3),
@@ -279,8 +425,9 @@ class Tracer:
         with open(tmp, "w") as f:
             for s in spans:
                 t1 = s.t1 if s.t1 is not None else s.t0
-                rec = {"name": s.name, "span_id": s.span_id,
-                       "parent_id": s.parent_id,
+                rec = {"name": s.name, "span_id": self.span_uid(s.span_id),
+                       "parent_id": self.span_uid(s.parent_id),
+                       "process": self.fingerprint,
                        "thread": tids.get(s.tid, str(s.tid)),
                        "ts": epoch + (s.t0 - origin),
                        "duration_s": round(t1 - s.t0, 9)}

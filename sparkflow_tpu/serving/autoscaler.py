@@ -41,11 +41,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..obs.flight import harvest_flight
 from ..resilience import faults
 from ..resilience.retry import RetryExhausted, RetryPolicy
 from ..utils import metrics as metrics_mod
@@ -103,6 +105,12 @@ class ReplicaManager:
         the process is killed and the attempt counts as failed.
     drain_timeout_s : float
         SIGTERM-to-SIGKILL grace on scale-down.
+    flight_dir : str, optional
+        Directory where managed replicas write their flight-recorder
+        files (``replica-<port>.jsonl``). When set, :meth:`drain` and
+        :meth:`destroy` harvest the dead replica's record — in-flight
+        trace ids and the last dumped spans — into
+        :attr:`flight_reports` before the record is dropped.
     """
 
     def __init__(self, launcher: Callable[[int], object], *,
@@ -112,7 +120,8 @@ class ReplicaManager:
                  health_timeout_s: float = 60.0,
                  drain_timeout_s: float = 10.0,
                  poll_interval_s: float = 0.2,
-                 metrics: Optional[metrics_mod.Metrics] = None):
+                 metrics: Optional[metrics_mod.Metrics] = None,
+                 flight_dir: Optional[str] = None):
         self.launcher = launcher
         self.membership = membership
         self.retry = retry if retry is not None else RetryPolicy(
@@ -123,8 +132,11 @@ class ReplicaManager:
         self.poll_interval_s = float(poll_interval_s)
         self.metrics = (metrics if metrics is not None
                         else membership.metrics)
+        self.flight_dir = flight_dir
         self._lock = threading.Lock()
         self._managed: Dict[int, _Managed] = {}  # replica.index -> record
+        # harvested flight records of dead replicas, newest last (bounded)
+        self.flight_reports: List[Dict[str, Any]] = []
 
     # -- introspection -------------------------------------------------------
 
@@ -211,6 +223,40 @@ class ReplicaManager:
         with self._lock:
             return self._managed.pop(replica.index, None)
 
+    def _harvest(self, replica: Replica, reason: str) -> None:
+        """Read the dead replica's flight-recorder file (after the process
+        is gone, so the file is settled) and keep the report: which trace
+        ids were in flight when it died, plus the last dumped spans if the
+        death was graceful enough to dump (SIGTERM yes, SIGKILL no)."""
+        path = replica.flight_path
+        if path is None and self.flight_dir is not None:
+            path = os.path.join(self.flight_dir,
+                                f"replica-{replica.port}.jsonl")
+        if path is None:
+            return
+        try:
+            report = harvest_flight(path)
+        except Exception:  # noqa: BLE001 - torn file must not block reaping
+            logger.exception("autoscaler: flight harvest failed for %s",
+                             replica.url)
+            return
+        if report is None:
+            return
+        report["replica_url"] = replica.url
+        # "reason" (if present) is the replica's own dump reason, e.g.
+        # "signal:15"; this is why the MANAGER removed it
+        report["harvest_reason"] = reason
+        with self._lock:
+            self.flight_reports.append(report)
+            del self.flight_reports[:-64]
+        self.metrics.incr("autoscaler/flight_harvested")
+        inflight = report.get("inflight_trace_ids", [])
+        if inflight:
+            logger.warning(
+                "autoscaler: replica %s died (%s) with %d in-flight "
+                "trace(s): %s", replica.url, reason, len(inflight),
+                ", ".join(inflight[:8]))
+
     def drain(self, replica: Replica, reason: str = "scale-down") -> None:
         """Graceful scale-down: eject from rotation now, SIGTERM (the
         server's lifecycle finishes in-flight work), wait, SIGKILL past
@@ -230,6 +276,7 @@ class ReplicaManager:
                     m.proc.wait(timeout=5.0)
                 except Exception:  # noqa: BLE001 - already gone
                     pass
+        self._harvest(replica, reason)
         self.membership.deregister(replica)
         logger.info("autoscaler: drained replica %s (%s)",
                     replica.url, reason)
@@ -244,6 +291,7 @@ class ReplicaManager:
                 m.proc.wait(timeout=5.0)
             except Exception:  # noqa: BLE001 - already gone
                 pass
+        self._harvest(replica, reason)
         self.membership.deregister(replica)
         logger.warning("autoscaler: destroyed replica %s (%s)",
                        replica.url, reason)

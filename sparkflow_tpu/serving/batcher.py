@@ -45,15 +45,17 @@ class Draining(QueueFull):
 
 
 class _Pending:
-    __slots__ = ("rows", "future", "enqueued_at", "request_id", "parent")
+    __slots__ = ("rows", "future", "enqueued_at", "request_id", "parent",
+                 "trace_id")
 
     def __init__(self, rows, future, enqueued_at, request_id=None,
-                 parent=None):
+                 parent=None, trace_id=None):
         self.rows = rows
         self.future = future
         self.enqueued_at = enqueued_at
         self.request_id = request_id  # X-Request-Id from the HTTP front
         self.parent = parent  # submitter's open Span (cross-thread link)
+        self.trace_id = trace_id  # fleet trace id (obs.TraceContext)
 
 
 class MicroBatcher:
@@ -109,7 +111,8 @@ class MicroBatcher:
     # -- client side ---------------------------------------------------------
 
     def submit(self, x, request_id: Optional[str] = None,
-               parent: Optional[spans_mod.Span] = None
+               parent: Optional[spans_mod.Span] = None,
+               trace_id: Optional[str] = None
                ) -> "Future[np.ndarray]":
         """Queue one request (``[n, ...]`` array, or one unbatched row, or a
         tuple of arrays for multi-input engines) and return a Future that
@@ -143,7 +146,7 @@ class MicroBatcher:
                     f"queue at capacity ({self._queued_rows}/{self.max_queue}"
                     f" rows); retry later")
             self._pending.append(_Pending(rows, fut, time.perf_counter(),
-                                          request_id, parent))
+                                          request_id, parent, trace_id))
             self._queued_rows += n
             self.metrics.observe("serving/queue_depth_rows",
                                  self._queued_rows)
@@ -319,10 +322,13 @@ class MicroBatcher:
             # post-hoc span: the wait interval is only known once the batch
             # forms; parent = the submitter's request span, so the chain
             # reads request -> queue_wait even across threads
+            wargs: Dict[str, Any] = {}
+            if p.request_id:
+                wargs["request_id"] = p.request_id
+            if p.trace_id:
+                wargs["trace_id"] = p.trace_id
             tracer.record("serving/queue_wait", p.enqueued_at, t_asm,
-                          parent=p.parent,
-                          args=({"request_id": p.request_id}
-                                if p.request_id else None))
+                          parent=p.parent, args=wargs or None)
             if not p.future.cancelled():
                 # attach BEFORE set_result: anyone woken by result() must
                 # already see the decomposition
@@ -340,10 +346,12 @@ class MicroBatcher:
 class _GenPending:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "eos_id", "seed", "future", "enqueued_at", "request_id",
-                 "parent", "admitted_at", "prefill_done_at", "slot", "tokens")
+                 "parent", "trace_id", "admitted_at", "prefill_done_at",
+                 "slot", "tokens")
 
     def __init__(self, prompt, max_new_tokens, temperature, top_k, eos_id,
-                 seed, future, enqueued_at, request_id=None, parent=None):
+                 seed, future, enqueued_at, request_id=None, parent=None,
+                 trace_id=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -354,6 +362,7 @@ class _GenPending:
         self.enqueued_at = enqueued_at
         self.request_id = request_id
         self.parent = parent
+        self.trace_id = trace_id
         self.admitted_at = None
         self.prefill_done_at = None
         self.slot = None
@@ -431,7 +440,8 @@ class ContinuousBatcher:
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
                request_id: Optional[str] = None,
-               parent: Optional[spans_mod.Span] = None) -> "Future[Dict]":
+               parent: Optional[spans_mod.Span] = None,
+               trace_id: Optional[str] = None) -> "Future[Dict]":
         """Queue one generation; the Future resolves to
         ``{"tokens": [...], "num_tokens": n, "finish_reason": "eos"|"length"}``."""
         prompt = [int(t) for t in prompt]
@@ -467,7 +477,8 @@ class ContinuousBatcher:
                     f"{self.max_queue}); retry later")
             self._pending.append(_GenPending(
                 prompt, max_new_tokens, float(temperature), int(top_k),
-                eos_id, seed, fut, time.perf_counter(), request_id, parent))
+                eos_id, seed, fut, time.perf_counter(), request_id, parent,
+                trace_id))
             self.metrics.observe("serving/decode/queue_depth",
                                  len(self._pending))
             self._cond.notify_all()
@@ -585,10 +596,15 @@ class ContinuousBatcher:
     def _prefill_one(self, req: _GenPending) -> None:
         """Run the engine prefill for one popped request and activate its
         slot (any-thread half; state updates re-acquire the lock)."""
+        aargs: Dict[str, Any] = {}
+        if req.request_id:
+            aargs["request_id"] = req.request_id
+        if req.trace_id:
+            aargs["trace_id"] = req.trace_id
         try:
             with self.tracer.span("serving/decode_admit",
-                                  args=({"request_id": req.request_id}
-                                        if req.request_id else None)):
+                                  args=aargs or None,
+                                  parent=req.parent):
                 info = self.engine.prefill(
                     req.prompt, max_new_tokens=req.max_new_tokens,
                     temperature=req.temperature, top_k=req.top_k,
@@ -638,11 +654,13 @@ class ContinuousBatcher:
         self.metrics.observe("serving/decode/request_latency_ms", total_ms)
         self.metrics.observe("serving/decode/tokens_per_request", ntok)
         self.metrics.incr("serving/decode/completed")
+        gargs: Dict[str, Any] = {"tokens": ntok}
+        if req.request_id:
+            gargs["request_id"] = req.request_id
+        if req.trace_id:
+            gargs["trace_id"] = req.trace_id
         self.tracer.record("serving/decode_generate", req.enqueued_at, now,
-                           parent=req.parent,
-                           args=({"request_id": req.request_id,
-                                  "tokens": ntok}
-                                 if req.request_id else {"tokens": ntok}))
+                           parent=req.parent, args=gargs)
         if not req.future.cancelled():
             req.future.request_id = req.request_id
             req.future.timing = {
@@ -659,13 +677,21 @@ class ContinuousBatcher:
     def _step_active(self) -> None:
         """One decode iteration + retirement. The engine call runs outside
         the batcher lock (it has its own); retirement updates re-acquire."""
+        t_tick0 = time.perf_counter()
         produced = self.engine.step()
+        t_tick1 = time.perf_counter()
         finished = []
+        ticked = []  # (req, tokens) for per-tick spans, recorded post-lock
         with self._cond:
             for slot, burst in produced.items():
                 req = self._active.get(slot)
                 if req is None:
                     continue
+                if req.trace_id:
+                    # per-tick decode attribution, only for requests that
+                    # carry a fleet trace id (untraced load stays span-free
+                    # on the hot path)
+                    ticked.append((req, len(burst)))
                 if req.prefill_done_at is None:
                     # chunked request's first token: TTFT stamps here
                     req.prefill_done_at = time.perf_counter()
@@ -685,6 +711,11 @@ class ContinuousBatcher:
                         break
             if finished:
                 self._cond.notify_all()  # wait_drained watches _active
+        for req, ntok in ticked:
+            self.tracer.record("serving/decode_tick", t_tick0, t_tick1,
+                               parent=req.parent,
+                               args={"trace_id": req.trace_id,
+                                     "slot": req.slot, "tokens": ntok})
         for req, reason in finished:
             self._finish(req, reason)
 

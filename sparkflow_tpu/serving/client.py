@@ -34,6 +34,7 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ..obs.spans import TRACEPARENT_HEADER, TraceContext
 from ..resilience.retry import RetryExhausted, RetryPolicy
 
 # Wire-level failures that mean "this pooled connection is dead" — safe to
@@ -264,10 +265,23 @@ class ServingClient:
                         e) from e
                 policy.sleep(delay)
 
+    @staticmethod
+    def _wire_headers(request_id: Optional[str],
+                      traceparent) -> Optional[Dict[str, str]]:
+        headers: Dict[str, str] = {}
+        if request_id:
+            headers["X-Request-Id"] = request_id
+        if traceparent is not None:
+            if isinstance(traceparent, TraceContext):
+                traceparent = traceparent.to_header()
+            headers[TRACEPARENT_HEADER] = str(traceparent)
+        return headers or None
+
     def generate(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, seed: Optional[int] = None,
                  request_id: Optional[str] = None,
+                 traceparent=None,
                  retries: Optional[int] = None,
                  timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """``POST /v1/generate``: autoregressive decode of ``prompt`` (a list
@@ -275,7 +289,10 @@ class ServingClient:
         ``finish_reason``, ``request_id``, ``timing_ms``, plus the echoed
         ``X-Request-Id`` header as ``x_request_id_header``. Retry semantics
         match :meth:`predict` (503s and connection errors back off and
-        re-send; 400s/500s raise immediately)."""
+        re-send; 400s/500s raise immediately). ``traceparent`` (a
+        :class:`~sparkflow_tpu.obs.spans.TraceContext` or a raw header
+        string) joins this call to an existing distributed trace; the
+        router/server otherwise mint a fresh one."""
         payload: Dict[str, Any] = {
             "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
             "max_new_tokens": int(max_new_tokens),
@@ -286,13 +303,14 @@ class ServingClient:
             payload["eos_id"] = int(eos_id)
         if seed is not None:
             payload["seed"] = int(seed)
-        headers = {"X-Request-Id": request_id} if request_id else None
+        headers = self._wire_headers(request_id, traceparent)
         budget = (self.retries if retries is None else int(retries)) + 1
         policy = self.retry_policy
         start = policy.clock()
         attempt = 0
         while True:
             try:
+                # graftcheck: dispatch-site
                 body, hdrs = self._request("/v1/generate", payload,
                                            headers=headers,
                                            with_headers=True,
@@ -317,19 +335,22 @@ class ServingClient:
                 policy.sleep(delay)
 
     def predict_full(self, inputs, request_id: Optional[str] = None,
+                     traceparent=None,
                      timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """One attempt (no retries), full reply: ``predictions``, ``rows``,
         the server's ``request_id`` (yours, echoed, if you passed one) and
         the per-request ``timing_ms`` latency decomposition. The echoed
         ``X-Request-Id`` response header is surfaced as
-        ``x_request_id_header``."""
+        ``x_request_id_header``. ``traceparent`` joins the call to an
+        existing distributed trace (see :meth:`generate`)."""
         if isinstance(inputs, dict):
             wire: Any = {k: np.asarray(v).tolist() for k, v in inputs.items()}
         else:
             wire = np.asarray(inputs).tolist()
+        # graftcheck: dispatch-site
         body, hdrs = self._request(
             "/v1/predict", {"inputs": wire},
-            headers=({"X-Request-Id": request_id} if request_id else None),
+            headers=self._wire_headers(request_id, traceparent),
             with_headers=True, timeout_s=timeout_s)
         body["x_request_id_header"] = hdrs.get("X-Request-Id")
         return body

@@ -208,6 +208,12 @@ class Replica:
         # live-weight version from /healthz ("serving_version"); -1 = not
         # yet probed. Canary dispatch keys on this.
         self.version = -1
+        # distributed-tracing advertisement from /healthz's "trace" block:
+        # the replica tracer's process fingerprint (namespaces its span ids
+        # in assembled traces) and where its flight recorder writes, so the
+        # ReplicaManager knows what to harvest when this replica dies.
+        self.trace_process: Optional[str] = None
+        self.flight_path: Optional[str] = None
         # when (by `clock`) the last successful probe harvested the load
         # figures above; 0.0 = never probed. The pick degrades stale load
         # reports to "unknown" via policies.probe_is_stale, and the
@@ -327,6 +333,15 @@ class Membership:
                     replica.version = int(body.get("serving_version", -1))
                 except (TypeError, ValueError):
                     replica.version = -1
+                tr = body.get("trace")
+                if isinstance(tr, dict):
+                    tp_fp = tr.get("process")
+                    replica.trace_process = (str(tp_fp) if tp_fp else None)
+                    fp_path = tr.get("flight")
+                    replica.flight_path = (str(fp_path) if fp_path else None)
+                else:
+                    replica.trace_process = None
+                    replica.flight_path = None
                 dec = body.get("decode")
                 if isinstance(dec, dict):
                     replica.decode_free_slots = int(dec.get("free_slots", -1))

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs
 
 from ..obs import spans as spans_mod
+from ..obs.collector import TraceCollector
 from ..obs.exporters import prometheus_text
 from ..resilience import faults
 from ..resilience.lifecycle import Lifecycle, ServerState
@@ -484,10 +485,20 @@ class RouterServer:
                  weight_store=None,
                  clock=time.monotonic,
                  metrics: Optional[metrics_mod.Metrics] = None,
-                 tracer: Optional[spans_mod.Tracer] = None):
+                 tracer: Optional[spans_mod.Tracer] = None,
+                 trace_sample: float = 0.01,
+                 trace_slow_factor: float = 1.0,
+                 trace_max: int = 256):
         self.metrics = metrics if metrics is not None else metrics_mod.Metrics()
         self.tracer = (tracer if tracer is not None
                        else spans_mod.default_tracer)
+        # fleet tracing: tail-sampled assembly of cross-process request
+        # timelines (errored/hedged/retried/slow requests always kept;
+        # trace_sample head-samples the rest). GET /traces/<id> serves the
+        # assembled waterfall.
+        self.collector = TraceCollector(
+            self.tracer, metrics=self.metrics, head_sample=trace_sample,
+            slow_factor=trace_slow_factor, max_traces=trace_max)
         # canary=True arms version-aware dispatch + the health gate; a
         # weight_store additionally lets a rollback repoint latest.json so
         # every replica's watcher reverts to the last good version
@@ -613,28 +624,48 @@ class RouterServer:
     def _run_attempt(self, replica: Replica, body: bytes,
                      headers: Dict[str, str], slot: _CallSlot,
                      is_hedge: bool,
-                     path: str = "/v1/predict") -> Dict[str, Any]:
+                     path: str = "/v1/predict",
+                     ctx: Optional[spans_mod.TraceContext] = None,
+                     info: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
         """One classified dispatch attempt. The outcome dict carries
         ``ok``/``retryable``/``status``/``obj`` plus breaker bookkeeping
-        side effects (success, failure, or drain ejection)."""
+        side effects (success, failure, or drain ejection), and ``span`` —
+        the attempt's dispatch span, relabeled winner/loser after a hedge
+        race resolves."""
         self.membership.begin_dispatch(replica, hedge=is_hedge)
+        if info is not None:
+            info["replicas"].append(replica.url)
+        sp_args: Dict[str, Any] = {"replica": replica.url, "hedge": is_hedge}
+        if ctx is not None:
+            sp_args["trace_id"] = ctx.trace_id
+        sp_ref: Optional[spans_mod.Span] = None
         try:
             faults.fire("replica.predict")
-            with self.tracer.span("router/dispatch",
-                                  args={"replica": replica.url,
-                                        "hedge": is_hedge}):
+            with self.tracer.span("router/dispatch", args=sp_args) as sp:
+                sp_ref = sp
+                attempt_headers = headers
+                if ctx is not None and sp is not None:
+                    # re-parent the replica's fragment under THIS attempt:
+                    # each hedge leg gets its own traceparent so the merged
+                    # waterfall shows which attempt reached which replica
+                    attempt_headers = dict(headers)
+                    attempt_headers[spans_mod.TRACEPARENT_HEADER] = (
+                        ctx.child(self.tracer.span_uid(sp.span_id))
+                        .to_header())
+                # graftcheck: dispatch-site
                 status, obj, _hdrs = self._call_replica(replica, body,
-                                                        headers, slot,
-                                                        path)
+                                                        attempt_headers,
+                                                        slot, path)
         except _Aborted:
             # lost a hedge race: the closed socket is our doing, not the
             # replica's — no breaker bookkeeping
             return {"ok": False, "retryable": False, "aborted": True,
-                    "replica": replica, "hedge": is_hedge}
+                    "replica": replica, "hedge": is_hedge, "span": sp_ref}
         except Exception as exc:  # noqa: BLE001 - wire failure = replica down
             self.membership.record_failure(replica, type(exc).__name__)
             return {"ok": False, "retryable": True, "exc": exc,
-                    "replica": replica, "hedge": is_hedge}
+                    "replica": replica, "hedge": is_hedge, "span": sp_ref}
         finally:
             self.membership.end_dispatch(replica)
         # what the outcome MEANS (eject / reroute / breaker-feed / pass
@@ -644,7 +675,7 @@ class RouterServer:
         if verdict == policies.OUTCOME_SUCCESS:
             self.membership.record_success(replica)
             return {"ok": True, "status": 200, "obj": obj,
-                    "replica": replica, "hedge": is_hedge}
+                    "replica": replica, "hedge": is_hedge, "span": sp_ref}
         if verdict == policies.OUTCOME_EJECT:
             # the replica caught SIGTERM: out of rotation NOW, reroute
             self.membership.eject(replica, "draining 503")
@@ -659,28 +690,37 @@ class RouterServer:
         return {"ok": False,
                 "retryable": verdict != policies.OUTCOME_CLIENT_ERROR,
                 "status": status, "obj": obj, "replica": replica,
-                "hedge": is_hedge}
+                "hedge": is_hedge, "span": sp_ref}
 
     def _attempt(self, primary: Replica, body: bytes,
                  headers: Dict[str, str],
-                 path: str = "/v1/predict") -> Dict[str, Any]:
+                 path: str = "/v1/predict",
+                 ctx: Optional[spans_mod.TraceContext] = None,
+                 info: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """One dispatch round: the primary call, optionally hedged with a
         duplicate to a second replica after the hedge delay. First success
         wins; losers are cancelled via their :class:`_CallSlot`."""
         if not self.hedge:
             return self._run_attempt(primary, body, headers, _CallSlot(),
-                                     False, path)
+                                     False, path, ctx, info)
 
         cond = threading.Condition()
         outcomes: List[Dict[str, Any]] = []
         slots: List[_CallSlot] = []
         launched = [0]
+        resolved: List[Optional[Dict[str, Any]]] = [None]  # winner, once known
 
         def run(replica: Replica, is_hedge: bool, slot: _CallSlot) -> None:
             out = self._run_attempt(replica, body, headers, slot,
-                                    is_hedge, path)
+                                    is_hedge, path, ctx, info)
             with cond:
                 outcomes.append(out)
+                if resolved[0] is not None and out is not resolved[0]:
+                    # the race resolved while this leg was still on the
+                    # wire (abort unblocked it late): self-label as loser
+                    sp = out.get("span")
+                    if sp is not None and sp.args is not None:
+                        sp.args["outcome"] = "loser"
                 cond.notify_all()
 
         def launch(replica: Replica, is_hedge: bool) -> None:
@@ -702,6 +742,8 @@ class RouterServer:
             second = self.membership.pick(exclude=[primary], signal=signal)
             if second is not None:
                 self.metrics.incr("router/hedges")
+                if info is not None:
+                    info["hedged"] = True
                 launch(second, True)
         with cond:
             cond.wait_for(
@@ -716,6 +758,18 @@ class RouterServer:
         for slot in all_slots:
             slot.abort()
         if winner is not None:
+            # label the race on the committed dispatch spans: the args dicts
+            # are live references, so the trace waterfall shows which hedge
+            # leg won even though the verdict postdates the spans (legs
+            # still on the wire self-label in run() via `resolved`)
+            with cond:
+                resolved[0] = winner
+                finished = list(outcomes)
+            for o in finished:
+                sp = o.get("span")
+                if sp is not None and sp.args is not None:
+                    sp.args["outcome"] = ("winner" if o is winner
+                                          else "loser")
             if winner["hedge"]:
                 self.metrics.incr("router/hedge_wins")
             return winner
@@ -731,7 +785,9 @@ class RouterServer:
                 "replica": primary, "hedge": False}
 
     def _dispatch(self, body: bytes, request_id: str,
-                  path: str = "/v1/predict"
+                  path: str = "/v1/predict",
+                  ctx: Optional[spans_mod.TraceContext] = None,
+                  info: Optional[Dict[str, Any]] = None
                   ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """Route one request (predict or generate): cache, then
         retry/reroute rounds. The result cache only fronts predict —
@@ -751,6 +807,10 @@ class RouterServer:
             self.metrics.incr("router/cache_misses")
         headers = {"Content-Type": "application/json",
                    "X-Request-Id": request_id}
+        if ctx is not None:
+            # base context; each attempt re-parents under its own dispatch
+            # span in _run_attempt
+            headers[spans_mod.TRACEPARENT_HEADER] = ctx.to_header()
         policy = self.retry_policy
         start = policy.clock()
         tried: List[Replica] = []
@@ -760,6 +820,8 @@ class RouterServer:
         for attempt in range(budget):
             if attempt:
                 self.metrics.incr("router/rerouted")
+                if info is not None:
+                    info["retried"] = True
             replica = self.membership.pick(exclude=tried, signal=signal)
             if replica is None and tried:
                 # every replica already tried this request — start a fresh
@@ -770,7 +832,7 @@ class RouterServer:
                 self.metrics.incr("router/no_healthy_replica")
             else:
                 t0 = time.perf_counter()
-                out = self._attempt(replica, body, headers, path)
+                out = self._attempt(replica, body, headers, path, ctx, info)
                 if self.canary_ctl is not None:
                     self._observe_canary(out, replica,
                                          (time.perf_counter() - t0) * 1000.0)
@@ -831,7 +893,8 @@ class RouterServer:
         return {"Retry-After": str(max(1, int(round(self.retry_after_s))))}
 
     def _predict(self, body: bytes, request_id: str,
-                 path: str = "/v1/predict"
+                 path: str = "/v1/predict",
+                 ctx: Optional[spans_mod.TraceContext] = None
                  ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         rid = {"X-Request-Id": request_id}
         self.metrics.incr("router/requests")
@@ -852,20 +915,41 @@ class RouterServer:
                 "message": f"router at capacity "
                            f"({self.max_inflight} in flight)"}}, \
                 {**self._retry_after(), **rid}
+        if ctx is None:
+            ctx = spans_mod.TraceContext.mint()
+        info: Dict[str, Any] = {"replicas": [], "hedged": False,
+                                "retried": False}
+        rargs = {"request_id": request_id, "trace_id": ctx.trace_id}
         t0 = time.perf_counter()
         try:
-            with self.tracer.span("router/request",
-                                  args={"request_id": request_id}):
+            with self.tracer.span("router/request", args=rargs):
                 status, obj, headers = self._dispatch(body, request_id,
-                                                      path)
+                                                      path, ctx, info)
         except Exception as exc:  # noqa: BLE001 - surface, don't hang
             self.metrics.incr("router/http_500")
+            self._observe_trace(ctx, (time.perf_counter() - t0) * 1000.0,
+                                True, info)
             return 500, {"error": {"code": "internal",
                                    "message": f"{type(exc).__name__}: "
                                               f"{exc}"}}, rid
-        self.metrics.observe("router/request_ms",
-                             (time.perf_counter() - t0) * 1000.0)
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        self.metrics.observe("router/request_ms", dur_ms)
+        self._observe_trace(ctx, dur_ms, status >= 500, info)
         return status, obj, headers
+
+    def _observe_trace(self, ctx: spans_mod.TraceContext, dur_ms: float,
+                       error: bool, info: Dict[str, Any]) -> None:
+        """Feed the tail sampler; assembly (rare by construction) fetches
+        the touched replicas' fragments. Never raises into the request."""
+        if not ctx.sampled:
+            return  # client explicitly opted this trace out
+        try:
+            self.collector.observe_request(
+                ctx.trace_id, dur_ms, error=error,
+                hedged=info["hedged"], retried=info["retried"],
+                replicas=list(dict.fromkeys(info["replicas"])))
+        except Exception:  # noqa: BLE001 - tracing must not fail serving
+            self.metrics.incr("trace/observe_errors")
 
     def _healthz(self) -> Tuple[int, Dict[str, Any],
                                 Optional[Dict[str, str]]]:
@@ -884,6 +968,8 @@ class RouterServer:
             body["cache"] = self.cache.stats()
         if self.canary_ctl is not None:
             body["canary"] = self.canary_ctl.stats()
+        body["trace"] = {"process": self.tracer.fingerprint,
+                         "kept": len(self.collector.trace_ids())}
         if serving and healthy:
             return 200, body, None
         return 503, body, self._retry_after()
@@ -951,6 +1037,28 @@ class RouterServer:
                             "text/plain; version=0.0.4; charset=utf-8")
                     else:
                         self._reply(*router._metrics_json())
+                elif path == "/traces":
+                    self._reply(200, {
+                        "traces": router.collector.trace_ids()})
+                elif path.startswith("/traces/"):
+                    tid = path[len("/traces/"):]
+                    trace = router.collector.get(tid)
+                    if trace is None:
+                        self._reply(404, {"error": {
+                            "code": "not_found",
+                            "message": f"no assembled trace {tid}"}})
+                    else:
+                        # re-assemble at read time: hedge legs that were
+                        # still on the wire at keep time have landed (and
+                        # self-labeled) by the time anyone reads the trace
+                        try:
+                            trace = router.collector.assemble(
+                                tid, replicas=trace.get("replicas", ()),
+                                reason=trace.get("reason", "manual"),
+                                duration_ms=trace.get("duration_ms"))
+                        except Exception:  # noqa: BLE001 - serve the cached one
+                            pass
+                        self._reply(200, trace)
                 else:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
@@ -962,6 +1070,12 @@ class RouterServer:
                     return
                 request_id = (self.headers.get("X-Request-Id")
                               or uuid.uuid4().hex)
+                # accept the client's trace context, or mint one: either
+                # way the response advertises the trace id back via the
+                # same traceparent header
+                ctx = (spans_mod.TraceContext.parse(
+                    self.headers.get(spans_mod.TRACEPARENT_HEADER))
+                    or spans_mod.TraceContext.mint())
                 if not router.lifecycle.try_begin_request():
                     router.metrics.incr("router/http_503")
                     self._reply(503, {"error": {
@@ -973,8 +1087,11 @@ class RouterServer:
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    self._reply(*router._predict(body, request_id,
-                                                 self.path))
+                    status, obj, hdrs = router._predict(body, request_id,
+                                                        self.path, ctx)
+                    hdrs = {**(hdrs or {}),
+                            spans_mod.TRACEPARENT_HEADER: ctx.to_header()}
+                    self._reply(status, obj, hdrs)
                 finally:
                     router.lifecycle.end_request()
 

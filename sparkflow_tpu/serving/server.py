@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal as signal_mod
 import threading
 import uuid
@@ -58,7 +59,9 @@ from urllib.parse import parse_qs
 import numpy as np
 
 from ..obs import spans as spans_mod
+from ..obs.collector import trace_spans
 from ..obs.exporters import MemoryWatcher, prometheus_text
+from ..obs.flight import FlightRecorder
 from ..resilience.lifecycle import Lifecycle, ServerState
 from .batcher import ContinuousBatcher, Draining, MicroBatcher, QueueFull
 
@@ -90,7 +93,8 @@ class InferenceServer:
                  tracer: Optional[spans_mod.Tracer] = None,
                  memory_watch: bool = True,
                  memory_interval_s: float = 5.0,
-                 weight_watcher=None):
+                 weight_watcher=None,
+                 flight_dir: Optional[str] = None):
         self.engine = engine
         # optional live-weight subscription (serving.weightstore): started/
         # stopped with the server; /healthz carries its serving_version so
@@ -120,6 +124,14 @@ class InferenceServer:
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]
+        # flight recorder: always-on crash evidence, keyed by port so the
+        # ReplicaManager can harvest <flight_dir>/replica-<port>.jsonl after
+        # reaping this process (see obs.flight)
+        self.flight: Optional[FlightRecorder] = None
+        if flight_dir:
+            self.flight = FlightRecorder(
+                os.path.join(flight_dir, f"replica-{self.port}.jsonl"),
+                tracer=self.tracer, metrics=self.metrics)
         self._thread: Optional[threading.Thread] = None
         self._prev_handlers: Dict[int, Any] = {}
 
@@ -133,6 +145,10 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="inference-server", daemon=True)
         self._thread.start()
+        if self.flight is not None:
+            # atexit-only arming: the SIGTERM dump rides the drain handler
+            # (install_signal_handlers), avoiding a second handler chain
+            self.flight.install(signals=())
         if self.memory_watcher is not None:
             self.memory_watcher.start()
         if self.weight_watcher is not None:
@@ -154,6 +170,11 @@ class InferenceServer:
             return False
 
         def on_signal(signum, frame):
+            if self.flight is not None:
+                # the last word goes to disk BEFORE the drain starts: if the
+                # grace window is cut short by SIGKILL, the dump already
+                # names what was in flight
+                self.flight.dump(reason=f"signal:{signum}")
             logger.warning("signal %d received: draining the inference "
                            "server", signum)
             threading.Thread(target=self.drain, name="serving-drain",
@@ -199,6 +220,9 @@ class InferenceServer:
         self.batcher.close()
         if self.generate_batcher is not None:
             self.generate_batcher.close()
+        if self.flight is not None:
+            self.flight.dump(reason="stop")
+            self.flight.close()
         self.lifecycle.transition(ServerState.STOPPED)
         if (self._prev_handlers
                 and threading.current_thread() is threading.main_thread()):
@@ -225,6 +249,11 @@ class InferenceServer:
         self.batcher.close(drain=False, timeout=1.0)
         if self.generate_batcher is not None:
             self.generate_batcher.close(drain=False, timeout=1.0)
+        if self.flight is not None:
+            # the chaos path leaves the file UNdumped on purpose: a killed
+            # process writes nothing either, and the harvest must still name
+            # the in-flight traces from begin/end lines alone
+            self.flight.close()
         self.lifecycle.transition(ServerState.STOPPED)
 
     def __enter__(self):
@@ -255,7 +284,21 @@ class InferenceServer:
                              "of rows, not an object")
         return np.asarray(inputs)
 
-    def _predict(self, body: bytes, request_id: str) -> Tuple:
+    def _span_args(self, request_id: str,
+                   ctx: Optional[spans_mod.TraceContext]) -> Dict[str, Any]:
+        """Root-span args for one request: the trace id seeds
+        ``obs.collector.trace_spans`` extraction, and ``parent_uid`` is the
+        cross-process link — the router attempt span this process's
+        fragment hangs under in the assembled waterfall."""
+        args: Dict[str, Any] = {"request_id": request_id}
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
+            if ctx.parent:
+                args["parent_uid"] = ctx.parent
+        return args
+
+    def _predict(self, body: bytes, request_id: str,
+                 ctx: Optional[spans_mod.TraceContext] = None) -> Tuple:
         # always (status, body, headers); the request id is echoed on every
         # outcome so a client/log line can be joined to server-side spans
         rid = {"X-Request-Id": request_id}
@@ -271,9 +314,11 @@ class InferenceServer:
         fut = None
         try:
             with self.tracer.span("serving/request",
-                                  args={"request_id": request_id}) as sp:
-                fut = self.batcher.submit(x, request_id=request_id,
-                                          parent=sp)
+                                  args=self._span_args(request_id,
+                                                       ctx)) as sp:
+                fut = self.batcher.submit(
+                    x, request_id=request_id, parent=sp,
+                    trace_id=ctx.trace_id if ctx is not None else None)
                 out = fut.result(timeout=self.request_timeout_s)
         except Draining as exc:
             # the drain began after this request was admitted; shed it the
@@ -305,7 +350,8 @@ class InferenceServer:
             resp["timing_ms"] = {k: round(v, 3) for k, v in timing.items()}
         return 200, resp, rid
 
-    def _generate(self, body: bytes, request_id: str) -> Tuple:
+    def _generate(self, body: bytes, request_id: str,
+                  ctx: Optional[spans_mod.TraceContext] = None) -> Tuple:
         rid = {"X-Request-Id": request_id}
         if self.generate_batcher is None:
             self.metrics.incr("serving/http_404")
@@ -336,11 +382,13 @@ class InferenceServer:
         fut = None
         try:
             with self.tracer.span("serving/request",
-                                  args={"request_id": request_id}) as sp:
+                                  args=self._span_args(request_id,
+                                                       ctx)) as sp:
                 fut = self.generate_batcher.submit(
                     prompt, max_new_tokens=max_new, temperature=temperature,
                     top_k=top_k, eos_id=eos_id, seed=seed,
-                    request_id=request_id, parent=sp)
+                    request_id=request_id, parent=sp,
+                    trace_id=ctx.trace_id if ctx is not None else None)
                 out = fut.result(timeout=self.request_timeout_s)
         except Draining as exc:
             self.metrics.incr("serving/http_503")
@@ -403,6 +451,13 @@ class InferenceServer:
                 # serving_version: harvested by Membership probes so the
                 # router can do version-aware (canary) dispatch
                 "serving_version": self._serving_version(),
+                # trace advertisement: the membership prober harvests this so
+                # the router knows each replica's tracer fingerprint (process
+                # lane in merged waterfalls) and where its flight record is
+                "trace": {
+                    "process": self.tracer.fingerprint,
+                    "flight": (self.flight.path
+                               if self.flight is not None else None)},
                 "engine": stats}
         if self.weight_watcher is not None:
             body["weights"] = self.weight_watcher.stats()
@@ -509,6 +564,15 @@ class InferenceServer:
                             "text/plain; version=0.0.4; charset=utf-8")
                     else:
                         self._reply(*server._metrics())
+                elif path.startswith("/traces/"):
+                    # per-replica trace fragment: every span of this trace
+                    # still in the tracer ring, normalized (fingerprinted
+                    # ids, wall-clock ts) for router-side assembly
+                    tid = path[len("/traces/"):]
+                    self._reply(200, {
+                        "trace_id": tid,
+                        "process": server.tracer.fingerprint,
+                        "spans": trace_spans(server.tracer, tid)})
                 else:
                     self._reply(404, {"error": {"code": "not_found",
                                                 "message": self.path}})
@@ -526,6 +590,10 @@ class InferenceServer:
                 # either way every response carries X-Request-Id
                 request_id = (self.headers.get("X-Request-Id")
                               or uuid.uuid4().hex)
+                # fleet trace context rides the traceparent header (minted
+                # at the router; absent for direct single-replica clients)
+                ctx = spans_mod.TraceContext.parse(
+                    self.headers.get(spans_mod.TRACEPARENT_HEADER))
                 # admission control: a draining/stopped server sheds the
                 # request BEFORE reading work into the batcher, with a
                 # Retry-After hint for the balancer's re-dispatch
@@ -538,11 +606,15 @@ class InferenceServer:
                         {**server._retry_after(),
                          "X-Request-Id": request_id})
                     return
+                if server.flight is not None and ctx is not None:
+                    server.flight.begin(ctx.trace_id, request_id)
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    self._reply(*handle(body, request_id))
+                    self._reply(*handle(body, request_id, ctx))
                 finally:
+                    if server.flight is not None and ctx is not None:
+                        server.flight.end(ctx.trace_id)
                     server.lifecycle.end_request()
 
             def log_message(self, fmt, *args):  # quiet: metrics cover this

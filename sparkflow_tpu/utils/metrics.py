@@ -93,6 +93,12 @@ class _Histogram:
             s = sorted(self.samples)
         else:
             s = sorted(list(self.recent)[-int(window):])
+            if not s:
+                # the sliding tail can be empty while the reservoir is not
+                # (e.g. a histogram restored without its recent deque);
+                # fall back to the whole-stream sample rather than index
+                # into an empty list
+                s = sorted(self.samples)
         if len(s) == 1:
             return s[0]
         pos = (q / 100.0) * (len(s) - 1)
@@ -102,6 +108,13 @@ class _Histogram:
         return s[lo] * (1.0 - frac) + s[hi] * frac
 
     def summary(self) -> Dict[str, float]:
+        if not self.samples:
+            # an empty histogram summarizes to zeros (not ±inf min/max, not
+            # a ValueError): snapshot/exposition paths must render whatever
+            # exists without crashing on a series that never observed
+            return {"count": self.count, "sum": self.total, "mean": 0.0,
+                    "min": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0,
+                    "p99": 0.0}
         return {"count": self.count, "sum": self.total,
                 "mean": self.total / self.count if self.count else 0.0,
                 "min": self.vmin, "max": self.vmax,

@@ -1,0 +1,54 @@
+"""Subprocess replica for the flight-recorder harvest tests.
+
+Run as ``python tests/_trace_replica.py --port N --flight-dir D
+[--predict-delay-s S]``: a real :class:`InferenceServer` over a trivial
+echo engine, flight recorder armed, SIGTERM drain handlers installed.
+``--predict-delay-s`` makes every predict sleep, so the parent test can
+SIGKILL the process with a request (and its flight ``begin`` line)
+provably in flight.
+"""
+
+import argparse
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--flight-dir", required=True)
+    parser.add_argument("--predict-delay-s", type=float, default=0.0)
+    ns = parser.parse_args()
+
+    import numpy as np
+
+    from sparkflow_tpu.resilience.lifecycle import ServerState
+    from sparkflow_tpu.serving import InferenceServer
+
+    class EchoEngine:
+        max_batch = 8
+
+        def __init__(self, delay_s: float):
+            self.delay_s = delay_s
+
+        def predict(self, x):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            return np.asarray(x)
+
+        def stats(self):
+            return {}
+
+    server = InferenceServer(EchoEngine(ns.predict_delay_s), port=ns.port,
+                             max_delay_ms=0.5, memory_watch=False,
+                             flight_dir=ns.flight_dir)
+    server.start()
+    server.install_signal_handlers()
+    print(f"replica up on {server.url}", flush=True)
+    while server.lifecycle.state in (ServerState.STARTING,
+                                     ServerState.SERVING):
+        time.sleep(0.1)
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,564 @@
+"""Fleet-wide distributed tracing: traceparent context, cross-process
+span assembly, tail-sampled collection, and the crash flight recorder.
+
+Pins the PR's acceptance directly: one hedged, chaos-delayed
+``/v1/generate`` through a router and a 2-replica fleet yields a SINGLE
+assembled trace — router dispatch span, both hedge attempts with the
+loser marked, the winning replica's admission and per-tick decode spans —
+on one monotone wall-clock timeline; and a SIGKILLed replica's flight
+record, harvested by the ``ReplicaManager``, names the trace ids that
+were in flight when it died.
+
+The fleet tests run the replicas in-process but give each its OWN
+:class:`Tracer` — which reproduces the exact cross-process hazard (every
+tracer's span-id counter starts from zero, so un-namespaced ids collide)
+while staying fast; ``make trace-smoke`` runs the same waterfall over
+real replica subprocesses. The flight harvest tests DO spawn real
+subprocesses: SIGKILL evidence only counts if it survives a real SIGKILL.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sparkflow_tpu.nn as nn
+from sparkflow_tpu.graph_utils import build_graph
+from sparkflow_tpu.analysis import restrack
+from sparkflow_tpu.obs.collector import (MIN_P95_SAMPLES, TraceCollector,
+                                         trace_spans)
+from sparkflow_tpu.obs.exporters import prometheus_text
+from sparkflow_tpu.obs.flight import FlightRecorder, harvest_flight
+from sparkflow_tpu.obs.spans import TRACEPARENT_HEADER, TraceContext, Tracer
+from sparkflow_tpu.resilience.retry import RetryPolicy
+from sparkflow_tpu.serving import (InferenceEngine, InferenceServer,
+                                   RouterServer, ServingClient)
+from sparkflow_tpu.serving.autoscaler import ReplicaManager
+from sparkflow_tpu.serving.membership import Membership
+from sparkflow_tpu.utils.metrics import Metrics, _Histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- TraceContext (the wire format) ------------------------------------------
+
+
+def test_traceparent_mint_roundtrip():
+    ctx = TraceContext.mint()
+    assert len(ctx.trace_id) == 32 and ctx.parent is None and ctx.sampled
+    back = TraceContext.parse(ctx.to_header())
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.parent is None and back.sampled
+
+
+def test_traceparent_child_reparents_and_survives_roundtrip():
+    tracer = Tracer()
+    ctx = TraceContext.mint()
+    uid = tracer.span_uid(7)
+    child = ctx.child(uid)
+    assert child.trace_id == ctx.trace_id and child.parent == uid
+    # the uid uses ':' as its namespace separator precisely so the 4-part
+    # dash split of the header survives it
+    assert "-" not in uid
+    back = TraceContext.parse(child.to_header())
+    assert back is not None and back.parent == uid
+
+
+def test_traceparent_parse_tolerates_garbage():
+    assert TraceContext.parse(None) is None
+    assert TraceContext.parse("") is None
+    assert TraceContext.parse("not-a-header") is None
+    assert TraceContext.parse("00-zz-0-01") is None          # non-hex id
+    assert TraceContext.parse("00-" + "0" * 32 + "-x-01") is None  # zero id
+    assert TraceContext.parse("99-" + "a" * 32 + "-x-01") is None  # version
+    ctx = TraceContext.parse(f"00-{'a' * 32}-{'0' * 16}-00")
+    assert ctx is not None and not ctx.sampled
+
+
+def test_unsampled_context_skips_collection():
+    tracer = Tracer()
+    collector = TraceCollector(tracer, metrics=Metrics(), head_sample=1.0)
+    router_like = TraceContext.mint(sampled=False)
+    assert not router_like.sampled
+    # RouterServer._observe_trace returns before the collector for these;
+    # the flag must survive the header roundtrip to get there
+    assert not TraceContext.parse(router_like.to_header()).sampled
+    assert collector.trace_ids() == []
+
+
+# -- span-id namespacing (satellite: per-process fingerprints) ---------------
+
+
+def test_span_uids_from_distinct_tracers_never_collide(tmp_path):
+    a, b = Tracer(), Tracer()
+    for tracer in (a, b):
+        with tracer.span("work"):
+            pass
+    sa = a.spans()[0]
+    # the raw counter value collides across processes (each starts at 1);
+    # the exported uid namespaces it per tracer fingerprint
+    assert a.span_uid(1) != b.span_uid(1)
+    assert a.span_uid(sa.span_id).startswith(a.fingerprint)
+    path = str(tmp_path / "spans.jsonl")
+    a.export_jsonl(path)
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["span_id"] == a.span_uid(sa.span_id)
+    assert rec["process"] == a.fingerprint
+
+
+def test_wall_clock_anchor_merges_perf_counter_timelines():
+    tracer = Tracer()
+    now_wall = tracer.wall_time(time.perf_counter())
+    assert abs(now_wall - time.time()) < 0.25
+    # two tracers anchored at different moments agree on the same instant
+    other = Tracer()
+    t = time.perf_counter()
+    assert abs(tracer.wall_time(t) - other.wall_time(t)) < 0.25
+
+
+# -- empty-histogram hardening (satellite) -----------------------------------
+
+
+def test_empty_histogram_summary_is_zeros_not_valueerror():
+    h = _Histogram()
+    s = h.summary()
+    assert s == {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                 "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    # the scalar percentile read keeps its loud contract (callers that
+    # need a number must handle "no data yet" explicitly)
+    with pytest.raises(ValueError):
+        h.percentile(50)
+
+
+def test_windowed_percentile_empty_tail_falls_back_to_reservoir():
+    h = _Histogram()
+    h.samples = [5.0]  # restored without its recent deque
+    h.count = 1
+    assert h.percentile(50, window=16) == 5.0
+
+
+def test_metrics_snapshot_with_empty_histogram_does_not_raise():
+    m = Metrics()
+    m.observe("latency_ms", 3.0)
+    m._hists["phantom"] = _Histogram()  # observed zero times
+    summary = m.summary()
+    assert "latency_ms" in summary.get("histograms", summary)
+    text = prometheus_text(m)
+    assert "latency_ms" in text
+
+
+# -- prometheus exposition (satellite: HELP + collision de-dup) --------------
+
+
+def test_prometheus_text_has_help_lines_and_dedups_collisions():
+    m = Metrics()
+    m.incr("router/requests")
+    m.incr("router.requests")   # sanitizes to the same prometheus name
+    m.gauge("queue_depth", 2.0)
+    text = prometheus_text(m)
+    assert "# HELP" in text and "# TYPE" in text
+    assert "router_requests " in text or "router_requests{" in text
+    # the second family keeps its own identity under a suffixed name
+    assert "router_requests_2" in text
+
+
+# -- collector: extraction, tail sampling, assembly --------------------------
+
+
+def test_trace_spans_extracts_seed_descendants_and_ancestors():
+    tracer = Tracer()
+    tid = TraceContext.mint().trace_id
+    with tracer.span("serving/request", args={"trace_id": tid}):
+        with tracer.span("serving/decode_admit"):   # descendant, no tid
+            pass
+    with tracer.span("unrelated"):
+        pass
+    recs = trace_spans(tracer, tid)
+    assert [r["name"] for r in recs] == ["serving/request",
+                                        "serving/decode_admit"]
+    assert all(r["process"] == tracer.fingerprint for r in recs)
+    assert recs[1]["parent_id"] == recs[0]["span_id"]
+    assert trace_spans(tracer, "nope") == []
+
+
+def test_should_keep_reasons_and_head_sampling():
+    tracer = Tracer()
+    always = TraceCollector(tracer, metrics=Metrics(), head_sample=1.0)
+    never = TraceCollector(tracer, metrics=Metrics(), head_sample=0.0)
+    assert always.should_keep(1.0, error=True) == "error"
+    assert always.should_keep(1.0, hedged=True) == "hedged"
+    assert always.should_keep(1.0, retried=True) == "retried"
+    assert always.should_keep(1.0) == "head"
+    assert never.should_keep(1.0) is None
+
+
+def test_should_keep_slow_vs_live_p95_needs_warmup():
+    metrics = Metrics()
+    tracer = Tracer()
+    col = TraceCollector(tracer, metrics=metrics, head_sample=0.0,
+                         slow_factor=2.0)
+    for _ in range(200):
+        metrics.observe("router/request_ms", 10.0)
+    # cold sampler: below MIN_P95_SAMPLES requests seen, slow can't fire
+    assert col.should_keep(500.0) is None
+    for _ in range(MIN_P95_SAMPLES):
+        col.should_keep(10.0)
+    assert col.should_keep(500.0) == "slow"    # 500 >= 2.0 * p95(=10)
+    assert col.should_keep(12.0) is None       # not slow, not sampled
+
+
+def test_collector_assembly_ring_is_bounded():
+    tracer = Tracer()
+    col = TraceCollector(tracer, metrics=Metrics(), max_traces=3)
+    for i in range(5):
+        tid = f"{i:032x}"
+        with tracer.span("router/request", args={"trace_id": tid}):
+            pass
+        col.assemble(tid, reason="manual")
+    ids = col.trace_ids()
+    assert len(ids) == 3 and ids == [f"{i:032x}" for i in (2, 3, 4)]
+    assert col.get(f"{0:032x}") is None
+
+
+def test_collector_chrome_export_one_lane_per_process(tmp_path):
+    router_tr, replica_tr = Tracer(), Tracer()
+    tid = TraceContext.mint().trace_id
+    with router_tr.span("router/dispatch", args={"trace_id": tid}) as sp:
+        uid = router_tr.span_uid(sp.span_id)
+    with replica_tr.span("serving/request",
+                         args={"trace_id": tid, "parent_uid": uid}):
+        pass
+    col = TraceCollector(router_tr, metrics=Metrics())
+    trace = col.assemble(tid, reason="manual")
+    # splice the replica fragment in the way _fetch would
+    trace["spans"].extend(trace_spans(replica_tr, tid))
+    trace["spans"].sort(key=lambda r: r["ts"])
+    trace["processes"] = sorted({r["process"] for r in trace["spans"]})
+    chrome = col.to_chrome_trace(tid)
+    events = chrome["traceEvents"]
+    lanes = {e["pid"] for e in events if e["ph"] == "X"}
+    assert len(lanes) == 2      # one synthetic pid per process fingerprint
+    # the replica root was linked under the router span via parent_uid
+    reqs = [e for e in events if e.get("name") == "serving/request"]
+    assert reqs and reqs[0]["args"]["parent_id"] == uid
+    path = col.export_chrome_trace(tid, str(tmp_path / "t.json"))
+    assert json.load(open(path))["traceEvents"]
+    jl = col.export_jsonl(tid, str(tmp_path / "t.jsonl"))
+    lines = [json.loads(ln) for ln in open(jl)]
+    assert all(ln["trace_id"] == tid for ln in lines)
+    waterfall = TraceCollector.waterfall(col.get(tid))
+    assert "router/dispatch" in waterfall
+    assert "serving/request" in waterfall
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_begin_end_dump_harvest(tmp_path):
+    tracer = Tracer()
+    metrics = Metrics()
+    path = str(tmp_path / "replica-1.jsonl")
+    with tracer.span("serving/request", args={"trace_id": "t1"}):
+        pass
+    with FlightRecorder(path, tracer=tracer, metrics=metrics) as fr:
+        fr.begin("t1", request_id="r1")
+        fr.end("t1")
+        fr.begin("t2")           # dies in flight
+        metrics.incr("serving/requests")
+        fr.dump(reason="test")
+        fr.dump(reason="ignored")   # idempotent: one dump line
+    report = harvest_flight(path)
+    assert report is not None
+    assert report["process"] == tracer.fingerprint
+    assert report["begins"] == 2 and report["ends"] == 1
+    assert report["inflight_trace_ids"] == ["t2"]
+    assert report["dumped"] and report["reason"] == "test"
+    assert any(s["name"] == "serving/request" for s in report["spans"])
+    assert report["metric_deltas"]["serving/requests"] == 1.0
+    assert open(path).read().count('"event": "dump"') == 1
+
+
+def test_flight_harvest_survives_torn_tail_and_no_dump(tmp_path):
+    path = str(tmp_path / "replica-2.jsonl")
+    fr = FlightRecorder(path, tracer=Tracer(), metrics=Metrics())
+    fr.begin("dead-trace")
+    fr.close()                   # SIGKILL semantics: no dump line
+    with open(path, "a") as f:
+        f.write('{"event": "beg')   # torn mid-write line
+    report = harvest_flight(path)
+    assert report["inflight_trace_ids"] == ["dead-trace"]
+    assert not report["dumped"]
+    assert harvest_flight(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_flight_recorder_compacts_matched_pairs(tmp_path):
+    from sparkflow_tpu.obs import flight as flight_mod
+    path = str(tmp_path / "replica-3.jsonl")
+    fr = FlightRecorder(path, tracer=Tracer(), metrics=Metrics())
+    fr.begin("keep-open")
+    for i in range(flight_mod.COMPACT_THRESHOLD + 2):
+        fr.begin(f"t{i}")
+        fr.end(f"t{i}")
+    fr.close()
+    lines = open(path).read().splitlines()
+    assert len(lines) < flight_mod.COMPACT_THRESHOLD
+    report = harvest_flight(path)
+    assert report["inflight_trace_ids"] == ["keep-open"]
+
+
+# -- fleet e2e: hedged generate assembles into ONE trace ---------------------
+
+
+IN, OUT = "x:0", "out/BiasAdd:0"
+VOCAB = 61
+
+
+def _mlp_graph():
+    x = nn.placeholder([None, 4], name="x")
+    h = nn.dense(x, 3, activation="relu")
+    out = nn.dense(h, 2, name="out")
+    nn.mean_squared_error(x, out)
+
+
+def _make_engine():
+    rs = np.random.RandomState(0)
+    weights = [rs.randn(4, 3).astype(np.float32),
+               rs.randn(3).astype(np.float32),
+               rs.randn(3, 2).astype(np.float32),
+               rs.randn(2).astype(np.float32)]
+    return InferenceEngine(build_graph(_mlp_graph), weights, input_name=IN,
+                           output_name=OUT, max_batch=16)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    from sparkflow_tpu.models.registry import (build_registry_spec,
+                                               model_from_json)
+    spec = build_registry_spec("transformer_lm", vocab_size=VOCAB, hidden=32,
+                               num_layers=2, num_heads=4, mlp_dim=64,
+                               max_len=32, dropout=0.0)
+    model = model_from_json(spec)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+class _ChaosPrefill:
+    """DecodeEngine wrapper whose prefill stalls — the chaos-delayed
+    straggler a hedge must race around."""
+
+    def __init__(self, engine, delay_s: float):
+        self._engine = engine
+        self.delay_s = delay_s
+
+    def prefill(self, *args, **kwargs):
+        time.sleep(self.delay_s)
+        return self._engine.prefill(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def _decode_server(lm, *, chaos_delay_s: float = 0.0) -> InferenceServer:
+    from sparkflow_tpu.serving import ContinuousBatcher, DecodeEngine
+    model, params = lm
+    engine = DecodeEngine(model, params, num_slots=4, page_size=8, seed=0)
+    if chaos_delay_s:
+        engine = _ChaosPrefill(engine, chaos_delay_s)
+    tracer = Tracer()
+    batcher = ContinuousBatcher(engine, max_queue=64, tracer=tracer)
+    srv = InferenceServer(_make_engine(), generate_batcher=batcher,
+                          max_delay_ms=1.0, tracer=tracer,
+                          memory_watch=False)
+    return srv.start()
+
+
+def test_hedged_generate_assembles_single_trace_with_loser_labeled(lm):
+    slow = _decode_server(lm, chaos_delay_s=1.2)
+    slow._httpd.handle_error = lambda *a: None  # hedge losers tear sockets
+    fast = _decode_server(lm)
+    router = RouterServer([slow.url, fast.url], probe_interval_s=60.0,
+                          hedge=True, hedge_delay_ms=100.0,
+                          dispatch_retries=1, tracer=Tracer(),
+                          trace_sample=0.0).start()
+    try:
+        ctx = TraceContext.mint()
+        client = ServingClient(router.url, retries=0)
+        out = client.generate([1, 2, 3], max_new_tokens=4, traceparent=ctx,
+                              timeout_s=60.0)
+        assert out["num_tokens"] == 4
+        client.close()
+
+        # hedged -> always kept, regardless of trace_sample=0.0
+        assert ctx.trace_id in router.collector.trace_ids()
+
+        # read-time re-assembly settles the loser leg's label
+        probe = ServingClient(router.url)
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            trace = probe._request(f"/traces/{ctx.trace_id}")
+            dispatches = [s for s in trace["spans"]
+                          if s["name"] == "router/dispatch"]
+            outcomes = sorted((s.get("args") or {}).get("outcome", "")
+                              for s in dispatches)
+            if outcomes == ["loser", "winner"]:
+                break
+            time.sleep(0.2)
+        probe.close()
+
+        # ONE trace: every fragment, from three distinct tracers whose
+        # local span ids collide, merged under one trace id
+        assert trace["trace_id"] == ctx.trace_id
+        assert trace["reason"] == "hedged"
+        assert len(trace["processes"]) == 3   # router + both replicas
+        names = [s["name"] for s in trace["spans"]]
+        assert "router/request" in names
+        assert outcomes == ["loser", "winner"], outcomes
+
+        # the winning replica's queue/admission and per-tick decode spans
+        # made it onto the timeline
+        assert "serving/request" in names
+        assert "serving/decode_admit" in names
+        assert names.count("serving/decode_tick") >= 4   # one per token
+
+        # monotone wall-clock ordering: spans sorted by ts, and every
+        # child starts no earlier than its parent (small anchor skew
+        # between tracers is tolerated)
+        ts = [s["ts"] for s in trace["spans"]]
+        assert ts == sorted(ts)
+        by_id = {s["span_id"]: s for s in trace["spans"]}
+        for s in trace["spans"]:
+            parent = by_id.get(s.get("parent_id"))
+            if parent is not None:
+                assert s["ts"] >= parent["ts"] - 0.05, (s, parent)
+
+        # hedge attempts hang under per-attempt re-parented contexts:
+        # each replica's serving/request links to a distinct dispatch
+        roots = {s.get("parent_id") for s in trace["spans"]
+                 if s["name"] == "serving/request"}
+        dispatch_ids = {s["span_id"] for s in trace["spans"]
+                        if s["name"] == "router/dispatch"}
+        assert roots and roots <= dispatch_ids and len(roots) == 2
+    finally:
+        router.stop()
+        fast.stop()
+        slow.kill()              # its batcher is mid-chaos-sleep
+
+
+def test_router_response_advertises_traceparent(lm):
+    fast = _decode_server(lm)
+    router = RouterServer([fast.url], probe_interval_s=60.0,
+                          tracer=Tracer(), trace_sample=1.0).start()
+    try:
+        client = ServingClient(router.url, retries=0)
+        body, hdrs = client._request(
+            "/v1/generate", {"prompt": [1, 2], "max_new_tokens": 2},
+            with_headers=True, timeout_s=60.0)
+        advertised = TraceContext.parse(hdrs.get(TRACEPARENT_HEADER))
+        assert advertised is not None
+        # head_sample=1.0 keeps even this boring request
+        assert advertised.trace_id in router.collector.trace_ids()
+        client.close()
+    finally:
+        router.stop()
+        fast.stop()
+
+
+# -- flight harvest over real subprocesses (SIGTERM + SIGKILL) ---------------
+
+
+def test_replica_manager_harvests_flight_records(tmp_path, monkeypatch):
+    """SIGTERM gets a dump; SIGKILL gets begin-line replay naming the
+    in-flight trace ids — both harvested by the ReplicaManager, with zero
+    leaked pooled connections under the resource tracker."""
+    monkeypatch.setenv("SPARKFLOW_TPU_RESTRACK", "1")
+    assert restrack.enabled()
+    tracker = restrack.ResourceTracker().install()
+    flight_dir = str(tmp_path)
+    delay = [0.0]
+
+    def launcher(port):
+        cmd = [sys.executable,
+               os.path.join(REPO, "tests", "_trace_replica.py"),
+               "--port", str(port), "--flight-dir", flight_dir]
+        if delay[0]:
+            cmd += ["--predict-delay-s", str(delay[0])]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        return subprocess.Popen(cmd, env=env)
+
+    metrics = Metrics()
+    mem = Membership(["http://127.0.0.1:1"], metrics=metrics,
+                     probe_interval_s=0.2)
+    mem.deregister(mem.replicas[0])
+    rm = ReplicaManager(launcher, membership=mem,
+                        retry=RetryPolicy(max_attempts=2, base_s=0.2),
+                        health_timeout_s=120.0, drain_timeout_s=10.0,
+                        metrics=metrics, flight_dir=flight_dir)
+    try:
+        # -- SIGTERM: graceful death dumps, harvest sees the dump --------
+        graceful = rm.spawn()
+        restrack.instrument_pool(graceful.pool)
+        mem.probe_all()
+        # the healthz advertisement tells the fleet where the recorder is
+        assert graceful.flight_path is not None
+        assert graceful.flight_path.endswith(
+            f"replica-{graceful.port}.jsonl")
+        ctx_done = TraceContext.mint()
+        client = ServingClient(graceful.url, retries=0)
+        client.predict_full(np.zeros((1, 4), np.float32),
+                            traceparent=ctx_done, timeout_s=30.0)
+        client.close()
+        rm.drain(graceful, reason="scale-down")
+        reports = {r["replica_url"]: r for r in rm.flight_reports}
+        rep = reports[graceful.url]
+        assert rep["dumped"] and rep["reason"].startswith("signal:")
+        assert rep["begins"] >= 1
+        assert ctx_done.trace_id not in rep["inflight_trace_ids"]
+
+        # -- SIGKILL: no dump, begin-line replay names the dead trace ----
+        delay[0] = 30.0
+        doomed = rm.spawn()
+        restrack.instrument_pool(doomed.pool)
+        ctx_dead = TraceContext.mint()
+
+        def fire():
+            c = ServingClient(doomed.url, retries=0)
+            try:
+                c.predict_full(np.zeros((1, 4), np.float32),
+                               traceparent=ctx_dead, timeout_s=5.0)
+            except Exception:
+                pass   # killed out from under us — that is the test
+            finally:
+                c.close()
+
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        flight_file = os.path.join(flight_dir,
+                                   f"replica-{doomed.port}.jsonl")
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            if (os.path.exists(flight_file)
+                    and '"begin"' in open(flight_file).read()):
+                break
+            time.sleep(0.1)
+        rm.destroy(doomed, reason="crash")        # SIGKILL, no last word
+        t.join(timeout=30.0)
+        reports = {r["replica_url"]: r for r in rm.flight_reports}
+        rep = reports[doomed.url]
+        assert not rep["dumped"]
+        assert rep["inflight_trace_ids"] == [ctx_dead.trace_id]
+        assert rep["harvest_reason"] == "crash"
+        assert metrics.counters()["autoscaler/flight_harvested"] == 2.0
+    finally:
+        rm.stop_all(kill=True)
+        mem.stop()
+        tracker.uninstall()
+    tracker.assert_balanced()
